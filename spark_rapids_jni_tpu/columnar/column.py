@@ -1,0 +1,238 @@
+"""Device column model: Arrow-layout columns resident in TPU HBM as jax.Arrays.
+
+TPU-native analog of ``cudf::column`` / ``ai.rapids.cudf.ColumnVector`` (the handle
+targets of the reference FFI — RowConversionJni.cpp:31,36).  A column is:
+
+- ``data``:      jax.Array of the storage dtype (fixed-width types), or the uint8
+                 character buffer (STRING), or None (LIST/STRUCT parents).
+- ``validity``:  optional ``bool[n]`` jax.Array; None means all-valid.  The cudf
+                 1-bit/row packed wire form (row_conversion.cu:158-165) is produced
+                 only at wire boundaries via utils.bitmask.
+- ``offsets``:   optional ``int32[n+1]`` jax.Array for STRING/LIST (Arrow layout).
+- ``children``:  nested child columns (LIST child, STRUCT fields).
+
+Columns are registered pytrees, so whole tables flow through jit/pjit/shard_map and
+XLA sees only flat arrays.  The logical DType (incl. decimal scale) is static aux
+data — it participates in trace caching, matching how the reference passes
+(type-id, scale) out-of-band of the data buffers (RowConversion.java:113-118).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dtypes import DType, TypeId, BOOL8, STRING, INT8, from_numpy_dtype
+from ..utils import bitmask
+
+
+class Column:
+    __slots__ = ("dtype", "data", "validity", "offsets", "children")
+
+    def __init__(
+        self,
+        dtype: DType,
+        data: Optional[jnp.ndarray] = None,
+        validity: Optional[jnp.ndarray] = None,
+        offsets: Optional[jnp.ndarray] = None,
+        children: Sequence["Column"] = (),
+    ):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets
+        self.children = tuple(children)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def fixed(dtype: DType, data, validity=None) -> "Column":
+        data = jnp.asarray(data, dtype=dtype.jnp_dtype)
+        if validity is not None:
+            validity = jnp.asarray(validity, dtype=jnp.bool_)
+        return Column(dtype, data=data, validity=validity)
+
+    @staticmethod
+    def string(chars, offsets, validity=None) -> "Column":
+        chars = jnp.asarray(chars, dtype=jnp.uint8)
+        offsets = jnp.asarray(offsets, dtype=jnp.int32)
+        if validity is not None:
+            validity = jnp.asarray(validity, dtype=jnp.bool_)
+        return Column(STRING, data=chars, validity=validity, offsets=offsets)
+
+    @staticmethod
+    def list_(child: "Column", offsets, validity=None) -> "Column":
+        offsets = jnp.asarray(offsets, dtype=jnp.int32)
+        if validity is not None:
+            validity = jnp.asarray(validity, dtype=jnp.bool_)
+        return Column(DType(TypeId.LIST), validity=validity, offsets=offsets,
+                      children=(child,))
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, validity: Optional[np.ndarray] = None,
+                   dtype: Optional[DType] = None) -> "Column":
+        if dtype is None:
+            dtype = from_numpy_dtype(arr.dtype)
+        if arr.dtype.kind == "M":
+            arr = arr.view(dtype.storage)
+        if arr.dtype == np.bool_:
+            arr = arr.astype(np.uint8)
+        return Column.fixed(dtype, np.asarray(arr, dtype=dtype.storage), validity)
+
+    @staticmethod
+    def from_pylist(values, dtype: Optional[DType] = None) -> "Column":
+        """Build a column from a Python list; None entries become nulls.
+
+        Strings (str/bytes entries) build an Arrow-layout STRING column; numeric
+        entries build a fixed-width column of ``dtype`` (default inferred).
+        """
+        n = len(values)
+        valid = np.array([v is not None for v in values], np.bool_)
+        has_nulls = not valid.all()
+        non_null = [v for v in values if v is not None]
+        if dtype is not None and dtype.is_string or (
+            dtype is None and non_null and isinstance(non_null[0], (str, bytes))
+        ):
+            enc = [v.encode() if isinstance(v, str) else (v or b"") for v in
+                   (x if x is not None else b"" for x in values)]
+            lens = np.fromiter((len(e) for e in enc), np.int32, n)
+            offsets = np.zeros(n + 1, np.int32)
+            np.cumsum(lens, out=offsets[1:])
+            chars = np.frombuffer(b"".join(enc), np.uint8).copy()
+            return Column.string(chars, offsets, valid if has_nulls else None)
+        if dtype is None:
+            from ..dtypes import FLOAT64, INT64
+            if non_null and all(isinstance(v, bool) for v in non_null):
+                dtype = BOOL8
+            elif any(isinstance(v, float) for v in non_null):
+                dtype = FLOAT64
+            else:
+                dtype = INT64
+        fill = values[0] if n and values[0] is not None else 0
+        dense = np.array([v if v is not None else fill for v in values],
+                         dtype=dtype.storage)
+        return Column.fixed(dtype, dense, valid if has_nulls else None)
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def size(self) -> int:
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
+        if self.data is not None:
+            return self.data.shape[0]
+        if self.validity is not None:
+            return self.validity.shape[0]
+        if self.children:
+            return self.children[0].size
+        return 0
+
+    def __len__(self) -> int:
+        return self.size
+
+    @property
+    def nullable(self) -> bool:
+        return self.validity is not None
+
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        return int(self.size - jnp.sum(self.validity))
+
+    def valid_mask(self) -> jnp.ndarray:
+        """bool[n] mask; materialises all-True when validity is None."""
+        if self.validity is not None:
+            return self.validity
+        return jnp.ones((self.size,), jnp.bool_)
+
+    def packed_validity(self) -> jnp.ndarray:
+        """cudf wire-format mask: 1 bit/row in LSB-first uint32 words."""
+        return bitmask.pack_bits(self.valid_mask())
+
+    # -- host round trip ---------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Dense values (nulls undefined); pair with ``validity_numpy``."""
+        if self.dtype.is_string:
+            raise TypeError("use to_pylist() for STRING columns")
+        arr = np.asarray(self.data)
+        if self.dtype.id == TypeId.BOOL8:
+            return arr.astype(np.bool_)
+        return arr
+
+    def validity_numpy(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones((self.size,), np.bool_)
+        return np.asarray(self.validity)
+
+    def to_pylist(self):
+        valid = self.validity_numpy()
+        if self.dtype.is_string:
+            chars = np.asarray(self.data).tobytes()
+            offs = np.asarray(self.offsets)
+            return [
+                chars[offs[i]:offs[i + 1]].decode() if valid[i] else None
+                for i in range(self.size)
+            ]
+        if self.dtype.is_decimal:
+            import decimal
+            vals = np.asarray(self.data)
+            return [decimal.Decimal(int(v)).scaleb(self.dtype.scale) if ok else None
+                    for v, ok in zip(vals, valid)]
+        vals = self.to_numpy()
+        return [vals[i].item() if valid[i] else None for i in range(self.size)]
+
+    # -- structural ops (used by relational layer) -------------------------
+    def gather(self, indices: jnp.ndarray, indices_valid=None) -> "Column":
+        """Row gather; out-of-bounds/invalid gather rows become null.
+
+        Mirrors cudf gather semantics the relational ops are built on.
+        """
+        if self.dtype.is_string:
+            # gather on strings: recompute per-row slices host-free via lengths
+            raise NotImplementedError("string gather lives in ops.strings")
+        indices = jnp.asarray(indices)
+        # cudf out_of_bounds_policy::NULLIFY: OOB indices produce null rows
+        valid = (indices >= 0) & (indices < self.data.shape[0])
+        data = jnp.take(self.data, indices, axis=0, mode="clip")
+        if self.validity is not None:
+            valid = valid & jnp.take(self.validity, indices, axis=0, mode="clip")
+        if indices_valid is not None:
+            valid = valid & indices_valid
+        return Column(self.dtype, data=data, validity=valid)
+
+    def with_validity(self, validity) -> "Column":
+        return Column(self.dtype, self.data, validity, self.offsets, self.children)
+
+    def __repr__(self):
+        return (f"Column({self.dtype!r}, size={self.size}, "
+                f"nulls={'?' if self.validity is not None else 0})")
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        leaves = []
+        mask = 0
+        if self.data is not None:
+            leaves.append(self.data); mask |= 1
+        if self.validity is not None:
+            leaves.append(self.validity); mask |= 2
+        if self.offsets is not None:
+            leaves.append(self.offsets); mask |= 4
+        leaves.extend(self.children)
+        return tuple(leaves), (self.dtype, mask, len(self.children))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        dtype, mask, nchildren = aux
+        leaves = list(leaves)
+        data = leaves.pop(0) if mask & 1 else None
+        validity = leaves.pop(0) if mask & 2 else None
+        offsets = leaves.pop(0) if mask & 4 else None
+        return cls(dtype, data, validity, offsets, tuple(leaves))
+
+
+jax.tree_util.register_pytree_node(
+    Column,
+    lambda c: c.tree_flatten(),
+    Column.tree_unflatten,
+)

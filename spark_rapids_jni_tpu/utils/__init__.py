@@ -1,0 +1,3 @@
+from . import bitmask
+
+__all__ = ["bitmask"]

@@ -1,0 +1,57 @@
+"""Validity bitmask packing utilities.
+
+The engine stores validity as a ``bool[n]`` jax.Array (compute-friendly on the VPU);
+these helpers convert to/from the cudf wire format — 1 bit per row, LSB-first within
+32-bit words (reference row_conversion.cu:158-165 writes whole 32-bit validity words
+per warp ballot; :255-272 packs bits with aligned atomics).  Packing only happens at
+wire/host boundaries (row blobs, IPC bridge), never in the hot compute path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_bits(valid: jnp.ndarray, word_bits: int = 32) -> jnp.ndarray:
+    """Pack bool[n] -> uint{word_bits}[ceil(n/word_bits)], LSB-first.
+
+    Rows beyond n are padded with 0 (invalid), matching cudf's convention that
+    trailing mask bits are undefined-but-zeroed in fresh allocations.
+    """
+    if word_bits not in (8, 32):
+        raise ValueError(f"word_bits must be 8 or 32, got {word_bits}")
+    n = valid.shape[0]
+    nwords = (n + word_bits - 1) // word_bits
+    padded = jnp.zeros((nwords * word_bits,), jnp.bool_).at[:n].set(valid)
+    bits = padded.reshape(nwords, word_bits).astype(jnp.uint32)
+    shifts = jnp.arange(word_bits, dtype=jnp.uint32)
+    words = jnp.sum(bits << shifts, axis=1, dtype=jnp.uint32)
+    if word_bits == 8:
+        return words.astype(jnp.uint8)
+    return words
+
+
+def unpack_bits(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Unpack LSB-first packed words -> bool[n]."""
+    word_bits = words.dtype.itemsize * 8
+    shifts = jnp.arange(word_bits, dtype=words.dtype)
+    bits = (words[:, None] >> shifts[None, :]) & words.dtype.type(1)
+    return bits.reshape(-1)[:n].astype(jnp.bool_)
+
+
+def pack_bits_np(valid: np.ndarray, word_bits: int = 32) -> np.ndarray:
+    """Host-side (numpy) packing, same layout as :func:`pack_bits`."""
+    if word_bits not in (8, 32):
+        raise ValueError(f"word_bits must be 8 or 32, got {word_bits}")
+    n = valid.shape[0]
+    nwords = (n + word_bits - 1) // word_bits
+    padded = np.zeros((nwords * word_bits,), np.bool_)
+    padded[:n] = valid
+    le_bytes = np.packbits(padded, bitorder="little")
+    dt = {8: np.uint8, 32: np.uint32}[word_bits]
+    return le_bytes.view(dt) if word_bits == 8 else le_bytes.view("<u4")
+
+
+def unpack_bits_np(words: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(words.view(np.uint8), bitorder="little")[:n].astype(np.bool_)

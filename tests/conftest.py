@@ -1,0 +1,31 @@
+"""Test harness: run the whole suite on a virtual 8-device CPU mesh.
+
+The reference can only test on physical GPUs (ci/premerge-build.sh:20 asserts
+nvidia-smi) — a gap SURVEY.md §4 calls out.  We fix it: CPU-backed jax with 8
+virtual devices exercises every op and the full multi-chip sharding path without
+TPU hardware.  Tests that need a real TPU are marked ``requires_tpu`` (the analog
+of the reference's ``-Dtest=*,!CuFileTest`` hardware gating).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "requires_tpu: needs a physical TPU (skipped on CPU harness)"
+    )
+
+
+def pytest_runtest_setup(item):
+    if any(m.name == "requires_tpu" for m in item.iter_markers()):
+        if jax.devices()[0].platform != "tpu":
+            pytest.skip("requires physical TPU")

@@ -1,0 +1,139 @@
+"""Columnar core tests: dtypes, bitmask wire format, Column/Table round trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu as srt
+from spark_rapids_jni_tpu import dtypes
+from spark_rapids_jni_tpu.utils import bitmask
+
+
+class TestDtypes:
+    def test_itemsize_matches_storage(self):
+        assert dtypes.INT64.itemsize == 8
+        assert dtypes.FLOAT32.itemsize == 4
+        assert dtypes.BOOL8.itemsize == 1
+        assert dtypes.decimal32(-3).itemsize == 4
+        assert dtypes.decimal64(-8).itemsize == 8
+
+    def test_decimal_scale_guard(self):
+        with pytest.raises(ValueError):
+            dtypes.DType(dtypes.TypeId.INT32, scale=-2)
+
+    def test_cudf_type_ids_stable(self):
+        # wire-compat with the Java DType native ids (RowConversionJni.cpp:58-61)
+        assert int(dtypes.TypeId.STRING) == 23
+        assert int(dtypes.TypeId.DECIMAL64) == 26
+        assert int(dtypes.TypeId.BOOL8) == 11
+
+    def test_from_numpy_dtype(self):
+        assert dtypes.from_numpy_dtype(np.int32) == dtypes.INT32
+        assert dtypes.from_numpy_dtype(np.bool_) == dtypes.BOOL8
+        assert dtypes.from_numpy_dtype("datetime64[us]") == dtypes.TIMESTAMP_MICROSECONDS
+
+
+class TestBitmask:
+    @pytest.mark.parametrize("n", [0, 1, 31, 32, 33, 100, 257])
+    def test_pack_unpack_roundtrip(self, n):
+        rng = np.random.default_rng(n)
+        valid = rng.random(n) < 0.7
+        packed = bitmask.pack_bits(jnp.asarray(valid))
+        assert packed.dtype == jnp.uint32
+        assert packed.shape[0] == (n + 31) // 32
+        out = bitmask.unpack_bits(packed, n)
+        np.testing.assert_array_equal(np.asarray(out), valid)
+
+    def test_matches_numpy_packing(self):
+        valid = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1] * 9, bool)
+        dev = np.asarray(bitmask.pack_bits(jnp.asarray(valid)))
+        host = bitmask.pack_bits_np(valid)
+        np.testing.assert_array_equal(dev, host)
+
+    def test_lsb_first_wire_order(self):
+        # bit 0 of word 0 is row 0 — cudf convention (row_conversion.cu:162-164)
+        packed = np.asarray(bitmask.pack_bits(jnp.asarray(np.array([True] + [False] * 40))))
+        assert packed[0] == 1 and packed[1] == 0
+
+
+class TestColumn:
+    def test_fixed_width_roundtrip(self):
+        data = np.array([1, 2, 3, 4], np.int64)
+        col = srt.Column.from_numpy(data)
+        assert col.size == 4 and col.dtype == dtypes.INT64
+        np.testing.assert_array_equal(col.to_numpy(), data)
+
+    def test_nulls(self):
+        col = srt.Column.from_pylist([5, None, 1, None])
+        assert col.null_count() == 2
+        assert col.to_pylist() == [5, None, 1, None]
+
+    def test_bool_storage_is_byte(self):
+        col = srt.Column.from_pylist([True, False, None])
+        assert col.dtype == dtypes.BOOL8
+        assert col.data.dtype == jnp.uint8
+        assert col.to_pylist() == [True, False, None]
+
+    def test_decimal_column(self):
+        # decimal32 scale -3: stored int is value * 10^3 (RowConversionTest.java:37)
+        from decimal import Decimal
+        col = srt.Column.fixed(dtypes.decimal32(-3), np.array([1234, -500], np.int32))
+        assert col.to_pylist() == [Decimal("1.234"), Decimal("-0.5")]
+
+    def test_string_column(self):
+        col = srt.Column.from_pylist(["hello", None, "", "tpu"])
+        assert col.dtype.is_string
+        assert col.size == 4
+        assert col.to_pylist() == ["hello", None, "", "tpu"]
+
+    def test_gather_with_null_propagation(self):
+        col = srt.Column.from_pylist([10, None, 30])
+        out = col.gather(jnp.array([2, 0, 1]))
+        assert out.to_pylist() == [30, 10, None]
+
+    def test_column_is_pytree(self):
+        col = srt.Column.from_pylist([1, None, 3])
+        leaves = jax.tree_util.tree_leaves(col)
+        assert len(leaves) == 2  # data + validity
+
+        @jax.jit
+        def double(c):
+            return srt.Column(c.dtype, c.data * 2, c.validity)
+
+        out = double(col)
+        assert out.to_pylist() == [2, None, 6]
+
+
+class TestTable:
+    def test_pydict_roundtrip(self):
+        t = srt.Table.from_pydict({
+            "a": np.arange(5, dtype=np.int64),
+            "b": [1.5, None, 3.5, None, 5.5],
+            "s": ["x", "yy", None, "zzzz", ""],
+        })
+        assert t.num_rows == 5 and t.num_columns == 3
+        d = t.to_pydict()
+        assert d["a"] == [0, 1, 2, 3, 4]
+        assert d["b"] == [1.5, None, 3.5, None, 5.5]
+        assert d["s"] == ["x", "yy", None, "zzzz", ""]
+
+    def test_table_is_pytree_through_jit(self):
+        t = srt.Table.from_pydict({"a": np.arange(4, dtype=np.int64),
+                                   "b": np.ones(4, np.float64)})
+
+        @jax.jit
+        def f(tbl):
+            return srt.Table(
+                [srt.Column(c.dtype, c.data + 1, c.validity) for c in tbl.columns],
+                tbl.names)
+
+        out = f(t)
+        assert out.to_pydict()["a"] == [1, 2, 3, 4]
+        assert out.names == ("a", "b")
+
+    def test_select_and_gather(self):
+        t = srt.Table.from_pydict({"a": np.arange(4, dtype=np.int64),
+                                   "b": [None, 2, None, 4]})
+        g = t.select(["b"]).gather(jnp.array([3, 1, 0]))
+        assert g.to_pydict()["b"] == [4, 2, None]
