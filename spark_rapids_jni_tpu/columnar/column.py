@@ -76,7 +76,10 @@ class Column:
         if dtype is None:
             dtype = from_numpy_dtype(arr.dtype)
         if arr.dtype.kind == "M":
-            arr = arr.view(dtype.storage)
+            # datetime64 is always 8 bytes; TIMESTAMP_DAYS stores int32, so go
+            # through int64 before narrowing (a direct .view would reinterpret
+            # each 8-byte element as two int32 rows)
+            arr = arr.view(np.int64).astype(dtype.storage)
         if arr.dtype == np.bool_:
             arr = arr.astype(np.uint8)
         return Column.fixed(dtype, np.asarray(arr, dtype=dtype.storage), validity)
@@ -191,6 +194,8 @@ class Column:
         if self.dtype.is_string:
             # gather on strings: recompute per-row slices host-free via lengths
             raise NotImplementedError("string gather lives in ops.strings")
+        if self.dtype.is_nested:
+            raise NotImplementedError("nested-column gather is not supported yet")
         indices = jnp.asarray(indices)
         # cudf out_of_bounds_policy::NULLIFY: OOB indices produce null rows
         valid = (indices >= 0) & (indices < self.data.shape[0])

@@ -23,7 +23,7 @@ class Table:
         self.columns = tuple(columns)
         try:
             sizes = {c.size for c in self.columns}
-        except Exception:
+        except (AttributeError, TypeError, IndexError):
             sizes = set()  # placeholder leaves during tree_unflatten have no shape
         if len(sizes) > 1:
             raise ValueError(f"columns have differing row counts: {sorted(sizes)}")
@@ -77,6 +77,9 @@ class Table:
             names.append(k)
             if isinstance(v, Column):
                 cols.append(v)
+            elif isinstance(v, jax.Array):
+                from ..dtypes import from_numpy_dtype
+                cols.append(Column.fixed(from_numpy_dtype(v.dtype), v))
             elif isinstance(v, np.ndarray):
                 cols.append(Column.from_numpy(v))
             else:

@@ -1,0 +1,11 @@
+"""Op layer: Spark SQL columnar ops as jittable JAX/XLA programs.
+
+TPU-native analog of the reference's L3 kernel layer
+(src/main/cpp/src/row_conversion.cu and the later ops named in BASELINE.json).
+Every op is a free function over Columns/Tables, pure and jit-compatible, with
+sharding/donation replacing the reference's ``(stream, mr)`` tail parameters
+(reference row_conversion.hpp:27-36).
+"""
+
+from . import row_conversion  # noqa: F401
+from .row_conversion import convert_to_rows, convert_from_rows  # noqa: F401

@@ -18,6 +18,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The axon site hook (PYTHONPATH=/root/.axon_site) forces jax_platforms to
+# "axon,cpu" regardless of the env var; override it after import so the suite
+# really runs on the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -137,3 +137,31 @@ class TestTable:
                                    "b": [None, 2, None, 4]})
         g = t.select(["b"]).gather(jnp.array([3, 1, 0]))
         assert g.to_pydict()["b"] == [4, 2, None]
+
+
+def test_from_numpy_datetime_days():
+    import numpy as np
+    from spark_rapids_jni_tpu import dtypes as dt
+    from spark_rapids_jni_tpu.columnar import Column
+    c = Column.from_numpy(np.array(['2020-01-01', '2020-01-02'], 'datetime64[D]'))
+    assert c.dtype == dt.TIMESTAMP_DAYS and c.size == 2
+    np.testing.assert_array_equal(c.to_numpy(), [18262, 18263])
+
+
+def test_from_pydict_jax_array_keeps_dtype():
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.columnar import Table
+    t = Table.from_pydict({"x": jnp.array([1.5, 2.5], jnp.float64)})
+    np.testing.assert_array_equal(t["x"].to_numpy(), [1.5, 2.5])
+
+
+def test_nested_gather_raises_not_implemented():
+    import numpy as np
+    import jax.numpy as jnp
+    import pytest
+    from spark_rapids_jni_tpu.columnar import Column
+    child = Column.from_numpy(np.arange(3, dtype=np.int64))
+    lst = Column.list_(child, np.array([0, 1, 3], np.int32))
+    with pytest.raises(NotImplementedError):
+        lst.gather(jnp.array([0, 1]))

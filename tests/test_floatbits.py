@@ -1,0 +1,74 @@
+"""Property tests for f64<->bits.
+
+The public functions use the native bitcast on CPU (bit-exact); the arithmetic
+fallback (the TPU path) is tested explicitly here on CPU, where XLA exhibits
+the same DAZ/FTZ f64 behavior as the TPU backend, against numpy ground truth.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.utils.floatbits import (
+    f64_to_bits, bits_to_f64, f64_to_u32_pair, u32_pair_to_f64,
+    _f64_to_bits_arith, _bits_to_f64_arith,
+)
+
+TINY = np.finfo(np.float64).tiny  # smallest normal
+
+SPECIALS = np.array([
+    0.0, -0.0, 1.0, -1.0, 1.5, np.pi, np.inf, -np.inf,
+    np.finfo(np.float64).max, np.finfo(np.float64).min,
+    TINY, 2.0**-1022, 2.0**1023, 1e308, 1e-307,
+], dtype=np.float64)
+
+SUBNORMALS = np.array([5e-324, -5e-324, TINY / 2, -TINY / 2, 1e-310],
+                      dtype=np.float64)
+
+
+def test_bitcast_path_exact_incl_subnormals():
+    vals = np.concatenate([SPECIALS, SUBNORMALS])
+    got = np.asarray(f64_to_bits(jnp.asarray(vals)))
+    np.testing.assert_array_equal(got, vals.view(np.uint64))
+    back = np.asarray(bits_to_f64(jnp.asarray(vals.view(np.uint64))))
+    np.testing.assert_array_equal(back.view(np.uint64), vals.view(np.uint64))
+
+
+def test_arith_path_specials():
+    got = np.asarray(_f64_to_bits_arith(jnp.asarray(SPECIALS)))
+    np.testing.assert_array_equal(got, SPECIALS.view(np.uint64))
+
+
+def test_arith_path_subnormals_flush_signed_zero():
+    got = np.asarray(_f64_to_bits_arith(jnp.asarray(SUBNORMALS)))
+    want = np.where(np.signbit(SUBNORMALS), 1 << 63, 0).astype(np.uint64)
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(_bits_to_f64_arith(jnp.asarray(SUBNORMALS.view(np.uint64))))
+    np.testing.assert_array_equal(back, np.where(np.signbit(SUBNORMALS), -0.0, 0.0))
+    assert (np.signbit(back) == np.signbit(SUBNORMALS)).all()
+
+
+def test_arith_path_random_normals():
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2**64, size=5000, dtype=np.uint64)
+    vals = bits.view(np.float64)
+    bexp = (bits >> np.uint64(52)) & np.uint64(0x7FF)
+    normal = (bexp != 0) & (bexp != 0x7FF)
+    nan = np.isnan(vals)
+
+    got = np.asarray(_f64_to_bits_arith(jnp.asarray(vals)))
+    np.testing.assert_array_equal(got[normal], bits[normal])
+    assert (got[nan] == 0x7FF8000000000000).all()  # NaNs canonicalize
+
+    back = np.asarray(_bits_to_f64_arith(jnp.asarray(bits)))
+    np.testing.assert_array_equal(back[normal], vals[normal])
+    assert np.isnan(back[nan]).all()
+
+
+def test_u32_pair_roundtrip():
+    vals = jnp.asarray(SPECIALS)
+    lo, hi = f64_to_u32_pair(vals)
+    assert lo.dtype == jnp.uint32 and hi.dtype == jnp.uint32
+    back = np.asarray(u32_pair_to_f64(lo, hi))
+    np.testing.assert_array_equal(back, SPECIALS)
+    np.testing.assert_array_equal(np.asarray(lo), SPECIALS.view(np.uint32)[0::2])
+    np.testing.assert_array_equal(np.asarray(hi), SPECIALS.view(np.uint32)[1::2])
