@@ -49,10 +49,45 @@ class Column:
     # -- construction ------------------------------------------------------
     @staticmethod
     def fixed(dtype: DType, data, validity=None) -> "Column":
-        data = jnp.asarray(data, dtype=dtype.jnp_dtype)
+        if dtype.id == TypeId.FLOAT64:
+            # FLOAT64 stores IEEE bit patterns as int64 (dtypes.device_storage).
+            # The rule is input-dtype based, identical for host and device
+            # input: FLOAT input holds *values* (host converts exactly by view;
+            # device converts on-device — exact on CPU, clamped to what the
+            # TPU f64 emulation represents); INTEGER input already holds *bit
+            # patterns* and passes through.
+            if not hasattr(data, "devices"):  # host: ndarray / sequence
+                arr = np.asarray(data)
+                if arr.dtype.kind in "iu":
+                    data = jnp.asarray(arr.astype(np.int64))
+                else:
+                    arr = np.ascontiguousarray(arr.astype(np.float64))
+                    data = jnp.asarray(arr.view(np.int64))
+            elif jnp.issubdtype(data.dtype, jnp.floating):
+                from ..utils.floatbits import f64_to_bits
+                data = f64_to_bits(jnp.asarray(data, jnp.float64)) \
+                    .astype(jnp.int64)
+            else:
+                data = jnp.asarray(data, jnp.dtype(dtype.device_storage))
+        else:
+            data = jnp.asarray(data, dtype=jnp.dtype(dtype.device_storage))
         if validity is not None:
             validity = jnp.asarray(validity, dtype=jnp.bool_)
         return Column(dtype, data=data, validity=validity)
+
+    def float_values(self) -> jnp.ndarray:
+        """Hardware float view of a FLOAT32/FLOAT64 column's data.
+
+        FLOAT64 data lives as bit patterns (see dtypes.device_storage); this
+        materializes jnp.float64 — exact on CPU, best-effort within the f64
+        emulation's range/precision on TPU.
+        """
+        if self.dtype.id == TypeId.FLOAT64:
+            from ..utils.floatbits import bits_to_f64
+            return bits_to_f64(self.data.astype(jnp.uint64))
+        if self.dtype.id == TypeId.FLOAT32:
+            return jnp.asarray(self.data, jnp.float32)
+        raise TypeError(f"not a float column: {self.dtype!r}")
 
     @staticmethod
     def string(chars, offsets, validity=None) -> "Column":
@@ -161,6 +196,8 @@ class Column:
         arr = np.asarray(self.data)
         if self.dtype.id == TypeId.BOOL8:
             return arr.astype(np.bool_)
+        if self.dtype.id == TypeId.FLOAT64:
+            return arr.view(np.float64)  # stored as bit patterns
         return arr
 
     def validity_numpy(self) -> np.ndarray:

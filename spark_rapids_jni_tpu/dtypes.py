@@ -152,6 +152,22 @@ class DType:
         return jnp.dtype(self.storage)
 
     @property
+    def device_storage(self) -> np.dtype:
+        """dtype of the on-device data buffer.
+
+        FLOAT64 columns store IEEE-754 *bit patterns* as int64: TPUs have no
+        f64 ALU and XLA's emulation holds f64 in an f32 pair, which cannot even
+        represent every double (verified on v5e: np.pi corrupts at transfer,
+        1e300 -> inf).  Integer storage is exact, so the data plane (row
+        conversion, hashing, sorting, shuffles) stays bit-perfect; float
+        *arithmetic* materializes the hardware approximation via
+        ``Column.float_values()``.
+        """
+        if self.id == TypeId.FLOAT64:
+            return np.dtype(np.int64)
+        return self.storage
+
+    @property
     def itemsize(self) -> int:
         """Bytes per element in the packed row wire format.
 

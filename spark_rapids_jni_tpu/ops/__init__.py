@@ -8,4 +8,16 @@ sharding/donation replacing the reference's ``(stream, mr)`` tail parameters
 """
 
 from . import row_conversion  # noqa: F401
+from . import hash  # noqa: F401
+from . import cast_strings  # noqa: F401
+from . import strings  # noqa: F401
+from . import strings_common  # noqa: F401
+from . import regex_rewrite  # noqa: F401
+
 from .row_conversion import convert_to_rows, convert_from_rows  # noqa: F401
+from .hash import murmur3_hash, xxhash64  # noqa: F401
+from .cast_strings import (  # noqa: F401
+    cast_to_integer, cast_to_float, cast_to_decimal, cast_to_bool,
+    cast_from_integer,
+)
+from .regex_rewrite import regex_matches  # noqa: F401

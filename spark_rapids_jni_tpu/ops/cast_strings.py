@@ -1,0 +1,343 @@
+"""CastStrings: Spark-semantics string <-> numeric/decimal/bool casts.
+
+TPU-native rebuild of the reference's CastStrings component (named in
+BASELINE.json's north-star op set; CUDA side appears post-snapshot as
+src/main/cpp/src/cast_string.cu).  Behavior follows Spark's CAST:
+
+- string -> int/long/short/byte: trim, optional sign, digits, optionally a
+  fraction that is validated but truncated (Spark's UTF8String.toLong accepts
+  "123.456" -> 123); anything else, or overflow, yields null (or raises when
+  ``ansi=True``, matching Spark ANSI mode).
+- string -> float/double: optional sign, digits with fraction and exponent,
+  case-insensitive "inf"/"infinity"/"nan" keywords, optional trailing d/f
+  suffix (Java parseDouble semantics).  Values may differ from the JVM by
+  ~1 ulp on >17-digit inputs — same caveat the cudf implementation documents.
+- string -> decimal(scale): exact integer parsing with HALF_UP rounding to the
+  target scale (cudf convention: negative scale = fractional digits), null on
+  overflow of the storage type.
+- int/bool -> string; string -> bool with Spark's accepted literal sets.
+
+Everything runs as one `lax.scan` state machine over the padded byte matrix —
+a data-parallel reformulation of the per-thread character loops a CUDA
+implementation uses; every row advances through the same per-character step on
+the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column
+from ..dtypes import DType, TypeId, BOOL8, STRING
+from .strings_common import to_padded_bytes, from_padded_bytes
+
+_U64 = jnp.uint64
+_I32 = jnp.int32
+
+# u64 mantissa capacity: accumulating another digit is safe below this
+_ACC_CAP = _U64((2**64 - 1 - 9) // 10)
+
+_POW10_U64 = jnp.asarray([10**k for k in range(20)], jnp.uint64)
+# f64 powers of ten, exact-to-double-rounding, index k -> 10^(k-350)
+_POW10_F64 = jnp.asarray(
+    np.array([float(f"1e{k}") for k in range(-350, 351)]),  # strtod: correctly
+    jnp.float64)                                            # rounded, inf/0 at ends
+
+
+def _trim_bounds(mat, lengths):
+    """Spark trims leading/trailing ASCII control+space (UTF8String.trim)."""
+    n, w = mat.shape
+    pos = jnp.arange(w, dtype=_I32)[None, :]
+    in_str = pos < lengths[:, None]
+    is_ws = (mat <= 32) | ~in_str
+    non_ws = ~is_ws
+    any_non = non_ws.any(axis=1)
+    start = jnp.argmax(non_ws, axis=1).astype(_I32)
+    end = (w - jnp.argmax(non_ws[:, ::-1], axis=1)).astype(_I32)
+    start = jnp.where(any_non, start, 0)
+    end = jnp.where(any_non, end, 0)
+    return start, end
+
+
+# parser states
+_S_START, _S_INT, _S_FRAC, _S_EXP0, _S_EXP, _S_BAD = range(6)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _parse_number(mat, lengths, allow_frac: bool, allow_exp: bool,
+                  accumulate_frac: bool, allow_suffix: bool = False):
+    """Data-parallel numeric-literal state machine.
+
+    Returns per-row arrays: neg, digits (u64 mantissa, int [+frac] digits),
+    frac_kept, dropped_int, exp (signed), has_digits, syntax_ok, overflow.
+    """
+    n, w = mat.shape
+    start, end = _trim_bounds(mat, lengths)
+
+    if allow_suffix:
+        # Java parseDouble accepts a trailing d/D/f/F suffix after the number
+        last = jnp.take_along_axis(
+            mat, jnp.clip(end - 1, 0, w - 1)[:, None], axis=1)[:, 0]
+        has_suffix = ((last == ord('d')) | (last == ord('D'))
+                      | (last == ord('f')) | (last == ord('F'))) & (end - start > 1)
+        end = jnp.where(has_suffix, end - 1, end)
+
+    zeros_i = jnp.zeros((n,), _I32)
+    carry = dict(
+        state=jnp.full((n,), _S_START, _I32),
+        neg=jnp.zeros((n,), jnp.bool_),
+        digits=jnp.zeros((n,), _U64),
+        ndigits=zeros_i, frac_kept=zeros_i, dropped_int=zeros_i,
+        exp=zeros_i, exp_digits=zeros_i, exp_neg=jnp.zeros((n,), jnp.bool_),
+    )
+
+    def step(c, xs):
+        ch, p = xs
+        active = (p >= start) & (p < end)
+        st = c["state"]
+        d = ch.astype(_I32) - ord('0')
+        is_digit = (d >= 0) & (d <= 9)
+        is_sign = (ch == ord('+')) | (ch == ord('-'))
+        is_dot = ch == ord('.')
+        is_e = (ch == ord('e')) | (ch == ord('E'))
+        at_start = p == start
+
+        # mantissa accumulation (int digits always; frac digits optionally)
+        acc_int = active & is_digit & ((st == _S_START) | (st == _S_INT))
+        acc_frac = active & is_digit & (st == _S_FRAC) & accumulate_frac
+        acc = acc_int | acc_frac
+        can = c["digits"] <= _ACC_CAP
+        new_digits = jnp.where(
+            acc & can, c["digits"] * _U64(10) + d.astype(_U64), c["digits"])
+        # dropped int digits shift the magnitude; dropped frac digits only
+        # lose precision
+        dropped_int = c["dropped_int"] + jnp.where(acc_int & ~can, 1, 0)
+        frac_kept = c["frac_kept"] + jnp.where(acc_frac & can, 1, 0)
+        ndigits = c["ndigits"] + jnp.where(
+            active & is_digit & (st != _S_EXP0) & (st != _S_EXP), 1, 0)
+
+        # exponent accumulation (cap well past any meaningful range)
+        acc_exp = active & is_digit & ((st == _S_EXP0) | (st == _S_EXP))
+        new_exp = jnp.where(acc_exp, jnp.minimum(c["exp"] * 10 + d, 99999),
+                            c["exp"])
+        exp_digits = c["exp_digits"] + jnp.where(acc_exp, 1, 0)
+
+        neg = jnp.where(active & at_start & (ch == ord('-')), True, c["neg"])
+        exp_neg = jnp.where(active & (st == _S_EXP0) & (ch == ord('-')),
+                            True, c["exp_neg"])
+
+        # state transitions
+        nxt = jnp.where(is_digit, jnp.where(
+            (st == _S_START) | (st == _S_INT), _S_INT, jnp.where(
+                st == _S_FRAC, _S_FRAC, jnp.where(
+                    (st == _S_EXP0) | (st == _S_EXP), _S_EXP, _S_BAD))),
+            _S_BAD)
+        nxt = jnp.where(is_sign & at_start & (st == _S_START), _S_START, nxt)
+        nxt = jnp.where(is_sign & (st == _S_EXP0) & ~at_start, _S_EXP, nxt)
+        if allow_frac:
+            nxt = jnp.where(
+                is_dot & ((st == _S_START) | (st == _S_INT)), _S_FRAC, nxt)
+        if allow_exp:
+            nxt = jnp.where(
+                is_e & ((st == _S_INT) | (st == _S_FRAC)) & (c["ndigits"] > 0),
+                _S_EXP0, nxt)
+        nxt = jnp.where(st == _S_BAD, _S_BAD, nxt)
+        state = jnp.where(active, nxt, st)
+
+        return dict(state=state, neg=neg, digits=new_digits, ndigits=ndigits,
+                    frac_kept=frac_kept, dropped_int=dropped_int, exp=new_exp,
+                    exp_digits=exp_digits, exp_neg=exp_neg), None
+
+    pos = jnp.arange(w, dtype=_I32)
+    carry, _ = jax.lax.scan(step, carry, (mat.T, pos))
+
+    st = carry["state"]
+    syntax_ok = ((st == _S_INT) | (st == _S_FRAC) | (st == _S_EXP)) \
+        & (carry["ndigits"] > 0) & (end > start)
+    # "1e+" / "1e-" reach _S_EXP via the sign without any exponent digit
+    syntax_ok = syntax_ok & ~((st == _S_EXP) & (carry["exp_digits"] == 0))
+    exp = jnp.where(carry["exp_neg"], -carry["exp"], carry["exp"])
+    return dict(neg=carry["neg"], digits=carry["digits"],
+                frac_kept=carry["frac_kept"], dropped_int=carry["dropped_int"],
+                exp=exp, ndigits=carry["ndigits"], syntax_ok=syntax_ok,
+                overflow=carry["dropped_int"] > 0)
+
+
+_INT_BOUNDS = {
+    TypeId.INT8: 2**7, TypeId.INT16: 2**15, TypeId.INT32: 2**31,
+    TypeId.INT64: 2**63,
+}
+
+
+def _null_out(col: Column, ok):
+    return ok if col.validity is None else (ok & col.validity)
+
+
+def cast_to_integer(col: Column, dtype: DType, ansi: bool = False) -> Column:
+    """string -> byte/short/int/long with Spark CAST semantics."""
+    if dtype.id not in _INT_BOUNDS:
+        raise TypeError(f"not an integer target: {dtype!r}")
+    mat, lengths = to_padded_bytes(col)
+    p = _parse_number(mat, lengths, True, False, False)
+    bound = _INT_BOUNDS[dtype.id]
+    limit = jnp.where(p["neg"], _U64(bound), _U64(bound - 1))
+    ok = p["syntax_ok"] & ~p["overflow"] & (p["digits"] <= limit)
+    mag = jnp.minimum(p["digits"], limit)  # clamp so the cast below is defined
+    signed = jnp.where(p["neg"],
+                       (~mag + _U64(1)).astype(jnp.int64),
+                       mag.astype(jnp.int64))
+    valid = _null_out(col, ok)
+    if ansi:
+        bad = bool((~ok & (col.valid_mask())).any())
+        if bad:
+            raise ValueError(f"invalid input for CAST to {dtype!r} in ANSI mode")
+    return Column(dtype, data=signed.astype(dtype.jnp_dtype), validity=valid)
+
+
+def _keyword_match(mat, start, end, word: bytes):
+    """Case-insensitive match of the trimmed region against a keyword."""
+    n, w = mat.shape
+    length = end - start
+    m = length == len(word)
+    for i, ch in enumerate(word):
+        pos = jnp.clip(start + i, 0, w - 1)
+        c = jnp.take_along_axis(mat, pos[:, None], axis=1)[:, 0]
+        lower = jnp.where((c >= 65) & (c <= 90), c + 32, c)
+        m = m & (lower == ch)
+    return m
+
+
+def cast_to_float(col: Column, dtype: DType, ansi: bool = False) -> Column:
+    """string -> float/double with Spark CAST semantics."""
+    if dtype.id not in (TypeId.FLOAT32, TypeId.FLOAT64):
+        raise TypeError(f"not a float target: {dtype!r}")
+    mat, lengths = to_padded_bytes(col)
+    start, end = _trim_bounds(mat, lengths)
+    p = _parse_number(mat, lengths, True, True, True, True)
+
+    # value = digits * 10^(exp + dropped_int - frac_kept)
+    eff = p["exp"] + p["dropped_int"] - p["frac_kept"]
+    eff = jnp.clip(eff, -350, 350)
+    scale = jnp.take(_POW10_F64, (eff + 350).astype(_I32))
+    mag = p["digits"].astype(jnp.float64) * scale
+    val = jnp.where(p["neg"], -mag, mag)
+
+    # keywords (after optional sign)
+    first = jnp.take_along_axis(
+        mat, jnp.clip(start, 0, mat.shape[1] - 1)[:, None], axis=1)[:, 0]
+    has_sign = (first == ord('+')) | (first == ord('-'))
+    kw_start = jnp.where(has_sign, start + 1, start)
+    kw_neg = first == ord('-')
+    is_inf = (_keyword_match(mat, kw_start, end, b"inf")
+              | _keyword_match(mat, kw_start, end, b"infinity"))
+    is_nan = _keyword_match(mat, kw_start, end, b"nan")  # sign allowed, ignored
+    val = jnp.where(is_inf, jnp.where(kw_neg, -jnp.inf, jnp.inf), val)
+    val = jnp.where(is_nan, jnp.nan, val)
+
+    ok = p["syntax_ok"] | is_inf | is_nan
+    valid = _null_out(col, ok)
+    if ansi and bool((~ok & col.valid_mask()).any()):
+        raise ValueError(f"invalid input for CAST to {dtype!r} in ANSI mode")
+    if dtype.id == TypeId.FLOAT32:
+        return Column(dtype, data=val.astype(jnp.float32), validity=valid)
+    return Column.fixed(dtype, val, validity=valid)  # FLOAT64 stores bits
+
+
+def cast_to_decimal(col: Column, dtype: DType, ansi: bool = False) -> Column:
+    """string -> decimal32/64 at the target scale, HALF_UP rounding.
+
+    cudf scale convention (dtypes.py): stored integer = value * 10^(-scale).
+    """
+    if not dtype.is_decimal:
+        raise TypeError(f"not a decimal target: {dtype!r}")
+    mat, lengths = to_padded_bytes(col)
+    p = _parse_number(mat, lengths, True, True, True)
+
+    # unscaled = digits * 10^shift, shift = -scale - frac_kept + exp + dropped
+    shift = (-dtype.scale) - p["frac_kept"] + p["exp"] + p["dropped_int"]
+    up = jnp.clip(shift, 0, 19)
+    down = jnp.clip(-shift, 0, 19)
+    mul = jnp.take(_POW10_U64, up.astype(_I32))
+    div = jnp.take(_POW10_U64, down.astype(_I32))
+    # overflow if digits * mul wraps: digits > max/mul
+    umax = _U64(2**64 - 1)
+    mul_ovf = (shift > 0) & (p["digits"] > umax // mul)
+    scaled_up = p["digits"] * jnp.where(mul_ovf, _U64(1), mul)
+    q = scaled_up // div
+    r = scaled_up % div
+    q = q + jnp.where((shift < 0) & (r * _U64(2) >= div), _U64(1), _U64(0))
+    q = jnp.where((shift > 19) & (p["digits"] > _U64(0)), umax, q)  # overflow
+
+    q = jnp.where(shift < -19, _U64(0), q)  # rounds to zero well below scale
+
+    store_max = _U64(2**31 - 1) if dtype.id == TypeId.DECIMAL32 else _U64(2**63 - 1)
+    store_min_mag = store_max + _U64(1)
+    limit = jnp.where(p["neg"], store_min_mag, store_max)
+    ok = p["syntax_ok"] & ~mul_ovf & ~p["overflow"] & (q <= limit)
+    mag = jnp.minimum(q, limit)
+    signed = jnp.where(p["neg"], (~mag + _U64(1)).astype(jnp.int64),
+                       mag.astype(jnp.int64))
+    valid = _null_out(col, ok)
+    if ansi and bool((~ok & col.valid_mask()).any()):
+        raise ValueError(f"invalid input for CAST to {dtype!r} in ANSI mode")
+    return Column(dtype, data=signed.astype(dtype.jnp_dtype), validity=valid)
+
+
+_TRUE_LITS = (b"t", b"true", b"y", b"yes", b"1")
+_FALSE_LITS = (b"f", b"false", b"n", b"no", b"0")
+
+
+def cast_to_bool(col: Column, ansi: bool = False) -> Column:
+    """string -> boolean with Spark's accepted literal sets."""
+    mat, lengths = to_padded_bytes(col)
+    start, end = _trim_bounds(mat, lengths)
+    is_true = functools.reduce(
+        jnp.bitwise_or, (_keyword_match(mat, start, end, lit) for lit in _TRUE_LITS))
+    is_false = functools.reduce(
+        jnp.bitwise_or, (_keyword_match(mat, start, end, lit) for lit in _FALSE_LITS))
+    ok = is_true | is_false
+    valid = _null_out(col, ok)
+    if ansi and bool((~ok & col.valid_mask()).any()):
+        raise ValueError("invalid input for CAST to BOOLEAN in ANSI mode")
+    return Column(BOOL8, data=is_true.astype(jnp.uint8), validity=valid)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _int_to_digit_matrix(vals: jnp.ndarray, width: int):
+    """(u8[n, width] char matrix, lengths) rendering of int64 values."""
+    neg = vals < 0
+    u = vals.astype(jnp.uint64)  # wraps mod 2^64
+    mag = jnp.where(neg, _U64(0) - u, u)  # correct incl. INT64_MIN
+    # digits most-significant-first over a static 20-slot window
+    ndig = jnp.ones(vals.shape, _I32)
+    for k in range(1, 20):
+        ndig = jnp.where(mag >= jnp.take(_POW10_U64, k), k + 1, ndig)
+    total = ndig + neg.astype(_I32)
+    out = jnp.zeros(vals.shape + (width,), jnp.uint8)
+    for i in range(min(width, 21)):
+        # position i holds digit index (total-1-i) counting from least significant
+        di = total - 1 - i
+        p10 = jnp.take(_POW10_U64, jnp.clip(di, 0, 19).astype(_I32))
+        digit = (mag // p10) % _U64(10)
+        ch = jnp.where((i == 0) & neg, jnp.uint8(ord('-')),
+                       digit.astype(jnp.uint8) + jnp.uint8(ord('0')))
+        out = out.at[:, i].set(jnp.where(i < total, ch, jnp.uint8(0)))
+    return out, total
+
+
+def cast_from_integer(col: Column) -> Column:
+    """byte/short/int/long/decimal-unscaled -> string (Spark CAST)."""
+    if not col.dtype.is_integral and not col.dtype.is_decimal \
+            and col.dtype.id != TypeId.BOOL8:
+        raise TypeError(f"expected integral column, got {col.dtype!r}")
+    if col.dtype.id == TypeId.BOOL8:
+        strs = ["true" if v else "false" if v is not None else None
+                for v in col.to_pylist()]
+        return Column.from_pylist(strs, STRING)
+    vals = jnp.asarray(col.data).astype(jnp.int64)
+    mat, lengths = _int_to_digit_matrix(vals, 21)
+    return from_padded_bytes(mat, lengths, col.validity)

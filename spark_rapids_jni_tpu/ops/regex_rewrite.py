@@ -1,0 +1,96 @@
+"""RegexRewrite: lower simple regex patterns onto literal string predicates.
+
+TPU-native equivalent of the reference's RegexRewrite component (named in
+BASELINE.json's north-star set; Java side appears post-snapshot as
+RegexRewriteUtils).  Its job in spark-rapids is to recognize regex patterns
+that are really literal prefix/suffix/contains tests and dispatch them to fast
+non-regex kernels instead of a regex engine.  We implement the same contract:
+
+    rewrite(pattern)            -> ("startswith"|"endswith"|"contains"|"equals",
+                                    literal) or None
+    regex_matches(col, pattern) -> BOOL8 column, raising ValueError for
+                                   patterns outside the rewritable subset
+                                   (a general TPU regex engine is out of scope,
+                                   exactly as it is for the reference kernels).
+
+Recognized shapes (anchors + literal + unbounded wildcards only):
+    ^lit$   -> equals        ^lit / ^lit.*  -> startswith
+    lit$ / .*lit$ -> endswith    lit / .*lit.* -> contains
+Escaped metacharacters (\\.) inside the literal are unescaped.
+"""
+
+from __future__ import annotations
+
+from ..columnar import Column
+from ..dtypes import BOOL8
+from . import strings as _s
+
+_META = set(".^$*+?()[]{}|\\")
+
+
+def _scan_literal(pattern: str, i: int) -> tuple[str, int]:
+    """Longest literal run starting at i; handles backslash escapes."""
+    out = []
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern) and pattern[i + 1] in _META:
+            out.append(pattern[i + 1])
+            i += 2
+        elif ch in _META:
+            break
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out), i
+
+
+def rewrite(pattern: str):
+    """Classify ``pattern``; return (kind, literal) or None if not rewritable."""
+    i, n = 0, len(pattern)
+    anchored_start = i < n and pattern[i] == "^"
+    if anchored_start:
+        i += 1
+    if pattern.startswith(".*", i):
+        i += 2
+        anchored_start = False  # ^.*lit == .*lit
+    lit, i = _scan_literal(pattern, i)
+    trailing_any = False
+    if pattern.startswith(".*", i):
+        i += 2
+        trailing_any = True
+    anchored_end = i < n and pattern[i] == "$"
+    if anchored_end:
+        i += 1
+        if trailing_any:
+            anchored_end = False  # lit.*$ == lit.*
+            trailing_any = True
+    if i != n or not lit:
+        return None
+    if anchored_start and anchored_end:
+        return ("equals", lit)
+    if anchored_start:
+        return ("startswith", lit)
+    if anchored_end:
+        return ("endswith", lit)
+    return ("contains", lit)
+
+
+def regex_matches(col: Column, pattern: str) -> Column:
+    """RLIKE via the rewrite table; raises for unsupported patterns."""
+    rw = rewrite(pattern)
+    if rw is None:
+        raise ValueError(
+            f"pattern {pattern!r} is outside the rewritable subset "
+            "(literal prefix/suffix/contains/equals)")
+    kind, lit = rw
+    if kind == "startswith":
+        return _s.starts_with(col, lit)
+    if kind == "endswith":
+        return _s.ends_with(col, lit)
+    if kind == "contains":
+        return _s.contains(col, lit)
+    sw = _s.starts_with(col, lit)
+    ln = _s.byte_length(col)
+    import jax.numpy as jnp
+    eq = (sw.data != 0) & (ln.data == len(lit.encode()))
+    return Column(BOOL8, data=eq.astype(jnp.uint8), validity=sw.validity)

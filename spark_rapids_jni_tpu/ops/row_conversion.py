@@ -35,7 +35,6 @@ import numpy as np
 
 from ..columnar import Column, Table
 from ..dtypes import DType, TypeId, INT8, UINT8
-from ..utils.floatbits import f64_to_u32_pair, u32_pair_to_f64
 
 # Reference parity: per-batch byte ceiling from cudf's int32 list offsets
 # (row_conversion.cu:384-386) and 32-row batch alignment (:477-479).
@@ -93,12 +92,11 @@ def _col_to_u32_parts(dtype: DType, data: jnp.ndarray) -> list[tuple[int, jnp.nd
     """
     size = dtype.itemsize
     if size == 8:
-        if dtype.id == TypeId.FLOAT64:
-            lo, hi = f64_to_u32_pair(data)
-        else:
-            pair = jax.lax.bitcast_convert_type(data, jnp.uint32)  # (n, 2) LE
-            lo, hi = pair[..., 0], pair[..., 1]
-        return [(4, lo), (4, hi)]
+        # FLOAT64 included: its device buffer already holds IEEE bit patterns
+        # as int64 (dtypes.device_storage), so every 8-byte type is an integer
+        # bitcast — exact on TPU, where 64-bit float bitcasts don't exist
+        pair = jax.lax.bitcast_convert_type(data, jnp.uint32)  # (n, 2) LE
+        return [(4, pair[..., 0]), (4, pair[..., 1])]
     if size == 4:
         return [(4, jax.lax.bitcast_convert_type(data, jnp.uint32))]
     if size == 2:
@@ -168,12 +166,9 @@ def _from_row_words(layout: RowLayout, words: jnp.ndarray):
     for dt, off in zip(layout.schema, layout.offsets):
         size = dt.itemsize
         if size == 8:
-            lo, hi = word_at(off), word_at(off + 4)
-            if dt.id == TypeId.FLOAT64:
-                data = u32_pair_to_f64(lo, hi)
-            else:
-                pair = jnp.stack([lo, hi], axis=-1)
-                data = jax.lax.bitcast_convert_type(pair, jnp.int64)
+            pair = jnp.stack([word_at(off), word_at(off + 4)], axis=-1)
+            data = jax.lax.bitcast_convert_type(pair, jnp.int64)
+            if dt.id != TypeId.FLOAT64:  # FLOAT64 keeps its bit-pattern buffer
                 data = data.astype(dt.jnp_dtype)
         elif size == 4:
             data = jax.lax.bitcast_convert_type(word_at(off), dt.jnp_dtype)

@@ -1,0 +1,263 @@
+"""String ops over Arrow-layout STRING columns.
+
+The compute form is the padded byte matrix (strings_common.py); results are
+BOOL8/INT32 columns (predicates) or new STRING columns.  Character semantics
+follow Spark: ``length``/``substring`` count UTF-8 characters, not bytes.
+
+These are the building blocks the reference's RegexRewrite component lowers
+regexes onto (startsWith/endsWith/contains — see regex_rewrite.py) plus the
+string functions NDS queries need.  Predicates are fully jit-able; ops that
+produce new STRING columns compact through the host at the API boundary
+(XLA needs static shapes; inside fused pipelines keep the matrix form).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column
+from ..dtypes import INT32, BOOL8
+from .strings_common import to_padded_bytes, from_padded_bytes
+
+_I32 = jnp.int32
+
+
+def _prop_valid(col: Column, extra=None):
+    v = col.validity
+    if extra is not None:
+        v = extra if v is None else (v & extra)
+    return v
+
+
+def byte_length(col: Column) -> Column:
+    """Byte length per row (jit-able straight off the offsets)."""
+    offsets = jnp.asarray(col.offsets, _I32)
+    return Column(INT32, data=offsets[1:] - offsets[:-1],
+                  validity=_prop_valid(col))
+
+
+def char_length(col: Column) -> Column:
+    """Spark ``length()``: UTF-8 character count (continuation bytes excluded)."""
+    mat, lengths = to_padded_bytes(col)
+    in_str = jnp.arange(mat.shape[1], dtype=_I32)[None, :] < lengths[:, None]
+    starts = ((mat & jnp.uint8(0xC0)) != jnp.uint8(0x80)) & in_str
+    return Column(INT32, data=starts.sum(axis=1, dtype=_I32),
+                  validity=_prop_valid(col))
+
+
+def upper(col: Column) -> Column:
+    """ASCII uppercase (multi-byte code points pass through unchanged)."""
+    mat, lengths = to_padded_bytes(col)
+    out = jnp.where((mat >= 97) & (mat <= 122), mat - 32, mat)
+    return from_padded_bytes(out, lengths, _prop_valid(col))
+
+
+def lower(col: Column) -> Column:
+    """ASCII lowercase (multi-byte code points pass through unchanged)."""
+    mat, lengths = to_padded_bytes(col)
+    out = jnp.where((mat >= 65) & (mat <= 90), mat + 32, mat)
+    return from_padded_bytes(out, lengths, _prop_valid(col))
+
+
+# ---------------------------------------------------------------------------
+# literal search predicates (the RegexRewrite lowering targets)
+# ---------------------------------------------------------------------------
+
+def _literal(pat) -> bytes:
+    return pat.encode() if isinstance(pat, str) else bytes(pat)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _match_positions(mat, lengths, pat: bytes):
+    """bool[n, W]: window at shift s equals ``pat`` and fits in the row."""
+    n, w = mat.shape
+    if len(pat) == 0:
+        fits = jnp.arange(w, dtype=_I32)[None, :] <= lengths[:, None]
+        return fits
+    padded = jnp.pad(mat, ((0, 0), (0, len(pat))))
+    eq = jnp.ones((n, w), jnp.bool_)
+    for i, b in enumerate(pat):
+        eq = eq & (padded[:, i:i + w] == jnp.uint8(b))
+    fits = (jnp.arange(w, dtype=_I32)[None, :]
+            <= (lengths[:, None] - len(pat)))
+    return eq & fits
+
+
+def starts_with(col: Column, pat) -> Column:
+    pat = _literal(pat)
+    mat, lengths = to_padded_bytes(col)
+    hit = _match_positions(mat, lengths, pat)[:, 0] if mat.shape[1] else \
+        jnp.zeros((len(col),), jnp.bool_)
+    if len(pat) == 0:
+        hit = jnp.ones((len(col),), jnp.bool_)
+    return Column(BOOL8, data=hit.astype(jnp.uint8), validity=_prop_valid(col))
+
+
+def ends_with(col: Column, pat) -> Column:
+    pat = _literal(pat)
+    mat, lengths = to_padded_bytes(col)
+    if len(pat) == 0:
+        hit = jnp.ones((len(col),), jnp.bool_)
+    else:
+        pos = _match_positions(mat, lengths, pat)
+        tailpos = jnp.clip(lengths - len(pat), 0, mat.shape[1] - 1)
+        hit = jnp.take_along_axis(pos, tailpos[:, None], axis=1)[:, 0]
+        hit = hit & (lengths >= len(pat))
+    return Column(BOOL8, data=hit.astype(jnp.uint8), validity=_prop_valid(col))
+
+
+def contains(col: Column, pat) -> Column:
+    pat = _literal(pat)
+    mat, lengths = to_padded_bytes(col)
+    if len(pat) == 0:
+        hit = jnp.ones((len(col),), jnp.bool_)
+    else:
+        hit = _match_positions(mat, lengths, pat).any(axis=1)
+    return Column(BOOL8, data=hit.astype(jnp.uint8), validity=_prop_valid(col))
+
+
+def find(col: Column, pat) -> Column:
+    """First byte index of ``pat`` per row, -1 when absent (cudf find())."""
+    pat = _literal(pat)
+    mat, lengths = to_padded_bytes(col)
+    pos = _match_positions(mat, lengths, pat)
+    first = jnp.argmax(pos, axis=1).astype(_I32)
+    found = pos.any(axis=1)
+    idx = jnp.where(found, first, _I32(-1))
+    if len(pat) == 0:
+        idx = jnp.zeros((len(col),), _I32)
+    return Column(INT32, data=idx, validity=_prop_valid(col))
+
+
+# ---------------------------------------------------------------------------
+# substring (character-based, Spark semantics)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _substring_matrix(mat, lengths, start: int, length: int | None):
+    n, w = mat.shape
+    in_str = jnp.arange(w, dtype=_I32)[None, :] < lengths[:, None]
+    is_start = ((mat & jnp.uint8(0xC0)) != jnp.uint8(0x80)) & in_str
+    nchars = is_start.sum(axis=1, dtype=_I32)
+    # byte offset of each character: scatter byte positions into char slots
+    char_no = jnp.cumsum(is_start, axis=1, dtype=_I32) - 1
+    char_no = jnp.where(is_start, char_no, w)  # park non-starts in a spare slot
+    bytepos = jnp.broadcast_to(jnp.arange(w, dtype=_I32)[None, :], (n, w))
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=_I32)[:, None], (n, w))
+    char_byte = jnp.full((n, w + 1), 0, _I32)
+    char_byte = char_byte.at[rows, char_no].set(bytepos, mode="drop")
+    # char index c >= nchars maps to the row's byte length
+    cidx = jnp.arange(w + 1, dtype=_I32)[None, :]
+    char_byte = jnp.where(cidx >= nchars[:, None], lengths[:, None], char_byte)
+
+    # Spark substring: 1-based, 0 treated as 1, negative counts from the end
+    if start > 0:
+        first_char = jnp.full((n,), start - 1, _I32)
+    elif start == 0:
+        first_char = jnp.zeros((n,), _I32)
+    else:
+        first_char = jnp.maximum(nchars + start, 0)
+    first_char = jnp.minimum(first_char, nchars)
+    if length is None:
+        last_char = nchars
+    else:
+        last_char = jnp.minimum(first_char + max(length, 0), nchars)
+
+    sb = jnp.take_along_axis(char_byte, first_char[:, None], axis=1)[:, 0]
+    eb = jnp.take_along_axis(char_byte, last_char[:, None], axis=1)[:, 0]
+    out_len = eb - sb
+    idx = sb[:, None] + jnp.arange(w, dtype=_I32)[None, :]
+    gathered = jnp.take_along_axis(
+        jnp.pad(mat, ((0, 0), (0, 1))), jnp.clip(idx, 0, w), axis=1)
+    keep = jnp.arange(w, dtype=_I32)[None, :] < out_len[:, None]
+    return jnp.where(keep, gathered, jnp.uint8(0)), out_len
+
+
+def substring(col: Column, start: int, length: int | None = None) -> Column:
+    """Spark ``substring(str, pos[, len])`` — character-based."""
+    mat, lengths = to_padded_bytes(col)
+    out, out_len = _substring_matrix(mat, lengths, int(start),
+                                     None if length is None else int(length))
+    return from_padded_bytes(out, out_len, _prop_valid(col))
+
+
+def concat(*cols: Column) -> Column:
+    """Spark ``concat``: null if any input is null (host-compacted)."""
+    mats = []
+    total_valid = None
+    lens = []
+    for c in cols:
+        m, l = to_padded_bytes(c)
+        mats.append(np.asarray(m))
+        lens.append(np.asarray(l))
+        v = c.validity_numpy()
+        total_valid = v if total_valid is None else (total_valid & v)
+    n = mats[0].shape[0]
+    out_len = np.sum(lens, axis=0)
+    out = np.zeros((n, int(out_len.max()) if n else 0), np.uint8)
+    pos = np.zeros(n, np.int64)
+    rows = np.arange(n)
+    for m, l in zip(mats, lens):
+        w = m.shape[1]
+        keep = np.arange(w)[None, :] < l[:, None]
+        tgt = pos[:, None] + np.arange(w)[None, :]
+        out[np.broadcast_to(rows[:, None], (n, w))[keep], tgt[keep]] = m[keep]
+        pos += l
+    has_null = total_valid is not None and not total_valid.all()
+    return from_padded_bytes(out, out_len,
+                             total_valid if has_null else None)
+
+
+# ---------------------------------------------------------------------------
+# SQL LIKE (%, _) — dynamic-programming match over the byte matrix
+# ---------------------------------------------------------------------------
+
+def _parse_like(pattern: str, escape: str = "\\"):
+    toks = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            toks.append(("lit", pattern[i + 1].encode()))
+            i += 2
+        elif ch == "%":
+            toks.append(("any", None))
+            i += 1
+        elif ch == "_":
+            toks.append(("one", None))
+            i += 1
+        else:
+            toks.append(("lit", ch.encode()))
+            i += 1
+    return tuple(toks)
+
+
+def like(col: Column, pattern: str, escape: str = "\\") -> Column:
+    """SQL LIKE — NFA over byte positions, one vectorized step per token.
+
+    Note: ``_`` matches one *byte* here; multi-byte UTF-8 characters under
+    ``_`` are a known divergence (cudf's like is byte-based too).
+    """
+    toks = _parse_like(pattern, escape)
+    mat, lengths = to_padded_bytes(col)
+    n, w = mat.shape
+    # reach[i, j] — pattern prefix consumed exactly j bytes of row i
+    reach = (jnp.arange(w + 1, dtype=_I32)[None, :] == 0)
+    reach = jnp.broadcast_to(reach, (n, w + 1))
+    inb = jnp.arange(w, dtype=_I32)[None, :] < lengths[:, None]
+    for kind, lit in toks:
+        if kind == "lit":
+            for b in lit:  # multi-byte UTF-8 pattern chars consume per byte
+                step = reach[:, :-1] & (mat == jnp.uint8(b)) & inb
+                reach = jnp.pad(step, ((0, 0), (1, 0)))
+        elif kind == "one":
+            step = reach[:, :-1] & inb
+            reach = jnp.pad(step, ((0, 0), (1, 0)))
+        else:  # '%' — consume any number of bytes: prefix-or to the right
+            reach = jax.lax.associative_scan(jnp.logical_or, reach, axis=1)
+    hit = jnp.take_along_axis(reach, lengths[:, None], axis=1)[:, 0]
+    return Column(BOOL8, data=hit.astype(jnp.uint8), validity=_prop_valid(col))

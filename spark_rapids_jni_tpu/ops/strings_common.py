@@ -1,0 +1,67 @@
+"""Shared string-column device representation helpers.
+
+STRING columns live in Arrow layout (uint8 char buffer + int32 offsets —
+columnar/column.py).  XLA wants static shapes, so string *compute* (hashing,
+casting, regex) runs over a padded byte matrix ``u8[n, width]`` produced here.
+``width`` is a trace-static padding bucket (next power of two of the longest
+row) so recompilation only happens when the longest string crosses a bucket
+boundary, not on every batch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column
+
+
+def pad_width_bucket(max_len: int, minimum: int = 4) -> int:
+    """Static padding bucket: next power of two >= max(max_len, minimum)."""
+    w = minimum
+    while w < max_len:
+        w *= 2
+    return w
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _gather_matrix(chars: jnp.ndarray, offsets: jnp.ndarray, width: int):
+    starts = offsets[:-1]
+    lengths = (offsets[1:] - starts).astype(jnp.int32)
+    idx = starts[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    mat = jnp.take(chars, idx, mode="clip")
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < lengths[:, None]
+    return jnp.where(mask, mat, jnp.uint8(0)), lengths
+
+
+def to_padded_bytes(col: Column, width: int | None = None):
+    """(u8[n, width] zero-padded byte matrix, int32[n] lengths) for a STRING column."""
+    if not col.dtype.is_string:
+        raise TypeError(f"expected STRING column, got {col.dtype!r}")
+    offsets = jnp.asarray(col.offsets, jnp.int32)
+    if width is None:
+        lens = np.diff(np.asarray(offsets))
+        width = pad_width_bucket(int(lens.max()) if lens.size else 0)
+    chars = col.data if col.data is not None and col.data.shape[0] else \
+        jnp.zeros((1,), jnp.uint8)
+    return _gather_matrix(jnp.asarray(chars, jnp.uint8), offsets, int(width))
+
+
+def from_padded_bytes(mat: jnp.ndarray, lengths: jnp.ndarray,
+                      validity=None) -> Column:
+    """Rebuild an Arrow-layout STRING column from a padded byte matrix.
+
+    Host-side compaction (np): fine at API boundaries; jit pipelines keep the
+    matrix form.
+    """
+    mat = np.asarray(mat)
+    lengths = np.asarray(lengths).astype(np.int64)
+    n = mat.shape[0]
+    offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    keep = np.arange(mat.shape[1])[None, :] < lengths[:, None]
+    chars = mat[keep]  # row-major boolean extraction == concatenated rows
+    return Column.string(chars, offsets, validity)

@@ -1,0 +1,204 @@
+"""CastStrings tests: Spark CAST semantics vectors.
+
+Covers the cast_string.cu-style vector classes named in BASELINE.json
+configs[1]: int parsing with trim/sign/fraction-truncation/overflow, float
+parsing with exponents and keywords, decimal parsing with HALF_UP rounding and
+precision overflow, bool literals, and int -> string rendering.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops.cast_strings import (
+    cast_to_integer, cast_to_float, cast_to_decimal, cast_to_bool,
+    cast_from_integer,
+)
+
+
+def S(*vals):
+    return Column.from_pylist(list(vals))
+
+
+# -- string -> integer ------------------------------------------------------
+
+def test_int_basic():
+    c = cast_to_integer(S("0", "42", "-7", "+13", "  99  ", "2147483647"),
+                        dt.INT32)
+    assert c.to_pylist() == [0, 42, -7, 13, 99, 2147483647]
+    assert c.dtype == dt.INT32
+
+
+def test_int_fraction_truncates():
+    # Spark UTF8String.toLong: "123.456" -> 123, "-1.9" -> -1
+    c = cast_to_integer(S("123.456", "-1.9", "5.", ".5"), dt.INT32)
+    assert c.to_pylist() == [123, -1, 5, 0]
+
+
+def test_int_invalid_to_null():
+    c = cast_to_integer(
+        S("", "  ", "abc", "1a", "--5", "+-5", "1e5", "1.5.2", "5 5", None),
+        dt.INT32)
+    assert c.to_pylist() == [None] * 10
+
+
+def test_int_overflow_to_null():
+    c = cast_to_integer(
+        S("2147483647", "2147483648", "-2147483648", "-2147483649",
+          "99999999999999999999999"), dt.INT32)
+    assert c.to_pylist() == [2147483647, None, -2147483648, None, None]
+
+
+def test_long_bounds():
+    c = cast_to_integer(
+        S("9223372036854775807", "-9223372036854775808",
+          "9223372036854775808"), dt.INT64)
+    assert c.to_pylist() == [2**63 - 1, -2**63, None]
+
+
+def test_byte_short_bounds():
+    assert cast_to_integer(S("127", "128", "-128"), dt.INT8).to_pylist() == \
+        [127, None, -128]
+    assert cast_to_integer(S("32767", "32768"), dt.INT16).to_pylist() == \
+        [32767, None]
+
+
+def test_int_ansi_raises():
+    with pytest.raises(ValueError):
+        cast_to_integer(S("1", "nope"), dt.INT32, ansi=True)
+    # nulls in input are fine in ansi mode
+    c = cast_to_integer(S("1", None), dt.INT32, ansi=True)
+    assert c.to_pylist() == [1, None]
+
+
+# -- string -> float --------------------------------------------------------
+
+def test_float_basic():
+    vals = ["0", "1.5", "-2.25", "1e3", "1.5e-2", "+.5", "3.", "1E2",
+            "123.456d", "2f"]
+    c = cast_to_float(S(*vals), dt.FLOAT64)
+    want = [0.0, 1.5, -2.25, 1000.0, 0.015, 0.5, 3.0, 100.0, 123.456, 2.0]
+    got = c.to_pylist()
+    assert got == pytest.approx(want, abs=0, rel=1e-15)
+
+
+def test_float_keywords():
+    c = cast_to_float(S("inf", "-inf", "Infinity", "-INFINITY", "NaN", "nan"),
+                      dt.FLOAT64)
+    got = c.to_pylist()
+    assert got[0] == np.inf and got[1] == -np.inf
+    assert got[2] == np.inf and got[3] == -np.inf
+    assert np.isnan(got[4]) and np.isnan(got[5])
+
+
+def test_float_invalid():
+    c = cast_to_float(S("", "abc", "1e", "1e+", "--1", "1.2.3", "d"),
+                      dt.FLOAT64)
+    assert c.to_pylist() == [None] * 7
+
+
+def test_float_exact_values():
+    # values exactly representable: parsing must be bit-exact
+    c = cast_to_float(S("0.5", "0.25", "123456789", "1024", "-0.125"),
+                      dt.FLOAT64)
+    assert c.to_pylist() == [0.5, 0.25, 123456789.0, 1024.0, -0.125]
+
+
+def test_float_extremes():
+    c = cast_to_float(S("1e400", "-1e400", "1e-400", "1.7976931348623157e308"),
+                      dt.FLOAT64)
+    got = c.to_pylist()
+    assert got[0] == np.inf and got[1] == -np.inf
+    assert got[2] == 0.0
+    assert got[3] == pytest.approx(1.7976931348623157e308, rel=1e-15)
+
+
+def test_float32_target():
+    c = cast_to_float(S("1.5", "3.4e38", "3.4e39"), dt.FLOAT32)
+    got = c.to_pylist()
+    assert got[0] == 1.5
+    assert got[1] == pytest.approx(3.4e38, rel=1e-6)
+    assert got[2] == np.inf  # overflows float32 to inf, matching Java
+
+
+# -- string -> decimal ------------------------------------------------------
+
+def test_decimal_basic():
+    c = cast_to_decimal(S("1.234", "-5.5", "42", "0.001"), dt.decimal64(-3))
+    # stored unscaled = value * 10^3
+    np.testing.assert_array_equal(c.to_numpy(), [1234, -5500, 42000, 1])
+
+
+def test_decimal_half_up_rounding():
+    c = cast_to_decimal(S("1.2345", "1.2344", "-1.2345", "2.5"),
+                        dt.decimal64(-3))
+    np.testing.assert_array_equal(c.to_numpy(), [1235, 1234, -1235, 2500])
+
+
+def test_decimal_exponent():
+    c = cast_to_decimal(S("1.2e2", "5e-3", "1.5e1"), dt.decimal64(-2))
+    np.testing.assert_array_equal(c.to_numpy(), [12000, 1, 1500])
+    # 5e-3 at scale -2 -> 0.005 -> rounds HALF_UP to 0.01 -> unscaled 1
+
+
+def test_decimal32_overflow():
+    c = cast_to_decimal(S("2147483.647", "2147483.648", "-2147483.648"),
+                        dt.decimal32(-3))
+    assert c.to_pylist()[0] == pytest.approx(
+        __import__("decimal").Decimal("2147483.647"))
+    assert c.to_pylist()[1] is None
+    # -2^31 unscaled is representable in int32
+    assert c.to_pylist()[2] == pytest.approx(
+        __import__("decimal").Decimal("-2147483.648"))
+
+
+def test_decimal_tiny_rounds_to_zero():
+    c = cast_to_decimal(S("1e-50", "4.9e-3"), dt.decimal64(-2))
+    np.testing.assert_array_equal(c.to_numpy(), [0, 0])
+
+
+# -- string -> bool ---------------------------------------------------------
+
+def test_bool_literals():
+    c = cast_to_bool(S("true", "TRUE", "t", "yes", "y", "1",
+                       "false", "f", "no", "n", "0", "maybe", ""))
+    assert c.to_pylist() == [True] * 6 + [False] * 5 + [None, None]
+
+
+# -- integer -> string ------------------------------------------------------
+
+def test_int_to_string():
+    vals = [0, 1, -1, 42, -12345, 2**63 - 1, -2**63, 1000000]
+    c = cast_from_integer(Column.from_pylist(vals, dt.INT64))
+    assert c.to_pylist() == [str(v) for v in vals]
+
+
+def test_int_to_string_nulls_and_roundtrip():
+    vals = [5, None, -77]
+    c = cast_from_integer(Column.from_pylist(vals, dt.INT64))
+    assert c.to_pylist() == ["5", None, "-77"]
+    back = cast_to_integer(c, dt.INT64)
+    assert back.to_pylist() == vals
+
+
+def test_bool_to_string():
+    c = cast_from_integer(Column.from_pylist([True, False, None]))
+    assert c.to_pylist() == ["true", "false", None]
+
+
+def test_decimal_rejects_float_suffix():
+    c = cast_to_decimal(S("1d", "1.5f", "2"), dt.decimal64(0))
+    assert c.to_pylist()[:2] == [None, None]
+    assert c.to_numpy()[2] == 2
+
+
+def test_decimal_zero_mantissa_large_exp():
+    c = cast_to_decimal(S("0e30", "0.0e25"), dt.decimal64(0))
+    np.testing.assert_array_equal(c.to_numpy(), [0, 0])
+
+
+def test_float_signed_nan():
+    c = cast_to_float(S("-nan", "+NaN"), dt.FLOAT64)
+    got = c.to_pylist()
+    assert np.isnan(got[0]) and np.isnan(got[1])

@@ -165,3 +165,19 @@ def test_nested_gather_raises_not_implemented():
     lst = Column.list_(child, np.array([0, 1, 3], np.int32))
     with pytest.raises(NotImplementedError):
         lst.gather(jnp.array([0, 1]))
+
+
+def test_float64_fixed_int_input_is_bits():
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu import dtypes as dt
+    from spark_rapids_jni_tpu.columnar import Column
+    bits = np.array([1.5, -2.25], np.float64).view(np.int64)
+    host = Column.fixed(dt.FLOAT64, bits)
+    dev = Column.fixed(dt.FLOAT64, jnp.asarray(bits))
+    np.testing.assert_array_equal(host.to_numpy(), [1.5, -2.25])
+    np.testing.assert_array_equal(dev.to_numpy(), [1.5, -2.25])
+    vals = Column.fixed(dt.FLOAT64, np.array([1.5, -2.25]))
+    np.testing.assert_array_equal(vals.to_numpy(), [1.5, -2.25])
+    np.testing.assert_array_equal(
+        np.asarray(vals.float_values()), [1.5, -2.25])

@@ -173,6 +173,7 @@ def test_jit_to_rows_traceable():
     """The kernel path stays inside one jit (no host sync per column)."""
     lay = fixed_width_layout([dt.INT64, dt.FLOAT64])
     from spark_rapids_jni_tpu.ops.row_conversion import _to_rows_bytes
-    datas = (jnp.arange(8, dtype=jnp.int64), jnp.arange(8, dtype=jnp.float64))
+    fcol = Column.from_numpy(np.arange(8, dtype=np.float64))  # bits storage
+    datas = (jnp.arange(8, dtype=jnp.int64), fcol.data)
     out = _to_rows_bytes(lay, datas, (None, None))
     assert out.shape == (8 * lay.row_size,)

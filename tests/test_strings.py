@@ -1,0 +1,159 @@
+"""String op + RegexRewrite tests (python ground truth per row)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops import strings as s
+from spark_rapids_jni_tpu.ops.regex_rewrite import rewrite, regex_matches
+
+STRS = ["", "a", "hello", "hello world", "héllo", "ababab", "xyz", None,
+        "ab", "world hello world", "日本語テキスト"]
+
+
+def C(vals=STRS):
+    return Column.from_pylist(list(vals))
+
+
+def test_byte_and_char_length():
+    got_b = s.byte_length(C()).to_pylist()
+    got_c = s.char_length(C()).to_pylist()
+    want_b = [len(v.encode()) if v is not None else None for v in STRS]
+    want_c = [len(v) if v is not None else None for v in STRS]
+    assert got_b == want_b
+    assert got_c == want_c
+
+
+def test_upper_lower_ascii():
+    vals = ["abc", "ABC", "MiXeD 123!", None]
+    assert s.upper(Column.from_pylist(vals)).to_pylist() == \
+        ["ABC", "ABC", "MIXED 123!", None]
+    assert s.lower(Column.from_pylist(vals)).to_pylist() == \
+        ["abc", "abc", "mixed 123!", None]
+
+
+@pytest.mark.parametrize("pat", ["", "a", "ab", "hello", "world", "ba", "z"])
+def test_predicates(pat):
+    col = C()
+    got_sw = s.starts_with(col, pat).to_pylist()
+    got_ew = s.ends_with(col, pat).to_pylist()
+    got_ct = s.contains(col, pat).to_pylist()
+    got_fd = s.find(col, pat).to_pylist()
+    for v, g1, g2, g3, g4 in zip(STRS, got_sw, got_ew, got_ct, got_fd):
+        if v is None:
+            assert g1 is None and g2 is None and g3 is None and g4 is None
+        else:
+            assert g1 == v.startswith(pat), (v, pat)
+            assert g2 == v.endswith(pat), (v, pat)
+            assert g3 == (pat in v), (v, pat)
+            assert g4 == v.encode().find(pat.encode()), (v, pat)
+
+
+@pytest.mark.parametrize("start,length", [
+    (1, None), (2, None), (1, 3), (2, 2), (0, 2), (-3, None), (-3, 2),
+    (5, 10), (100, 5), (-100, 2),
+])
+def test_substring_spark_semantics(start, length):
+    col = C()
+    got = s.substring(col, start, length).to_pylist()
+
+    def spark_substr(v):
+        if v is None:
+            return None
+        pos = start
+        if pos > 0:
+            begin = pos - 1
+        elif pos == 0:
+            begin = 0
+        else:
+            begin = max(len(v) + pos, 0)
+        end = len(v) if length is None else min(begin + max(length, 0), len(v))
+        return v[begin:end] if begin < len(v) else ""
+
+    assert got == [spark_substr(v) for v in STRS]
+
+
+def test_substring_multibyte():
+    col = Column.from_pylist(["héllo", "日本語テキスト"])
+    assert s.substring(col, 2, 2).to_pylist() == ["él", "本語"]
+
+
+def test_concat():
+    a = Column.from_pylist(["x", "ab", None, ""])
+    b = Column.from_pylist(["1", "23", "z", ""])
+    assert s.concat(a, b).to_pylist() == ["x1", "ab23", None, ""]
+
+
+@pytest.mark.parametrize("pattern", [
+    "%", "a%", "%a", "%ell%", "h_llo", "_", "__", "ab%ab", "%o w%",
+    "", "a", "hello", "%l%o%",
+])
+def test_like(pattern):
+    import re
+    col = C()
+    got = s.like(col, pattern).to_pylist()
+
+    rx = "^" + "".join(
+        ".*" if c == "%" else "." if c == "_" else re.escape(c)
+        for c in pattern) + "$"
+
+    for v, g in zip(STRS, got):
+        if v is None:
+            assert g is None
+        else:
+            # byte-based matching: compare against bytes-level regex
+            want = re.match(rx.encode(), v.encode(), re.DOTALL) is not None
+            assert g == want, (v, pattern)
+
+
+def test_like_escape():
+    col = Column.from_pylist(["50%", "50x", "a_b", "axb"])
+    assert s.like(col, "50\\%").to_pylist() == [True, False, False, False]
+    assert s.like(col, "a\\_b").to_pylist() == [False, False, True, False]
+
+
+def test_rewrite_classification():
+    assert rewrite("^abc") == ("startswith", "abc")
+    assert rewrite("^abc.*") == ("startswith", "abc")
+    assert rewrite("abc$") == ("endswith", "abc")
+    assert rewrite(".*abc$") == ("endswith", "abc")
+    assert rewrite("abc") == ("contains", "abc")
+    assert rewrite(".*abc.*") == ("contains", "abc")
+    assert rewrite("^abc$") == ("equals", "abc")
+    assert rewrite("^a\\.c$") == ("equals", "a.c")
+    assert rewrite("a+b") is None
+    assert rewrite("[ab]c") is None
+    assert rewrite("a|b") is None
+    assert rewrite("") is None
+
+
+def test_regex_matches():
+    col = Column.from_pylist(["hello", "hell", "say hello!", "oh hello", None])
+    assert regex_matches(col, "^hell").to_pylist() == \
+        [True, True, False, False, None]
+    assert regex_matches(col, "hello$").to_pylist() == \
+        [True, False, False, True, None]
+    assert regex_matches(col, ".*ell.*").to_pylist() == \
+        [True, True, True, True, None]
+    assert regex_matches(col, "^hello$").to_pylist() == \
+        [True, False, False, False, None]
+    with pytest.raises(ValueError):
+        regex_matches(col, "h(e|a)llo")
+
+
+def test_like_multibyte_pattern():
+    col = Column.from_pylist(["café", "cafè!!", "cafe", "café!"])
+    assert s.like(col, "café").to_pylist() == [True, False, False, False]
+    assert s.like(col, "café%").to_pylist() == [True, False, False, True]
+
+
+def test_concat_vectorized_matches():
+    import numpy as np
+    rng = np.random.default_rng(3)
+    a = Column.from_pylist(["".join(chr(rng.integers(97, 123))
+                                    for _ in range(rng.integers(0, 9)))
+                            for _ in range(50)])
+    b = Column.from_pylist([str(i) * (i % 4) for i in range(50)])
+    got = s.concat(a, b).to_pylist()
+    want = [x + y for x, y in zip(a.to_pylist(), b.to_pylist())]
+    assert got == want
