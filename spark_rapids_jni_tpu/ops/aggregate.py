@@ -1,0 +1,216 @@
+"""GroupBy aggregation: sort-based segmented reduction.
+
+The TPU-native answer to cudf's hash_groupby (what the reference's Spark plans
+call HashAggregate — BASELINE.json configs[2]).  A hash table with open
+addressing is a pointer-chasing structure XLA can't vectorize; sorting by the
+group keys and running segmented reductions is the same O(n log n) work
+expressed as radix sort + scans, which map perfectly onto the VPU:
+
+    1. order  = lexsort(key encodings)          (ops/order.py)
+    2. bounds = sorted row != previous row      (rows_differ_from_prev)
+    3. seg_id = cumsum(bounds) - 1
+    4. each aggregation = jax.ops.segment_<op>(values[order], seg_id)
+
+``groupby_padded`` is the fully jit-able core: output padded to n rows with a
+group-count scalar (static shapes for pjit pipelines — the distributed
+partial-aggregation path).  ``groupby`` compacts at the host boundary.
+
+Null semantics match Spark: null keys form their own group (nulls equal in
+GROUP BY); null values are excluded from sum/min/max/mean/count(col), while
+count(*) counts rows.  sum/mean over FLOAT64 use the hardware float
+approximation (float_values); min/max over FLOAT64 run on the total-order bit
+encoding and are exact.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..dtypes import DType, TypeId, INT64, FLOAT64
+from .order import SortKey, encode_keys, rows_differ_from_prev
+from .selection import gather_table
+from . import order as _order
+
+AGGS = ("sum", "min", "max", "mean", "count", "count_all")
+
+
+def _seg_ids(keys: list[SortKey]):
+    words = encode_keys(keys)
+    order = jnp.lexsort(tuple(reversed(words)))
+    bounds = rows_differ_from_prev(words, order)
+    seg = jnp.cumsum(bounds.astype(jnp.int32)) - 1
+    ngroups = jnp.where(order.shape[0] > 0, seg[-1] + 1, 0) \
+        if order.shape[0] else jnp.int32(0)
+    return order, seg, ngroups
+
+
+def _segment_reduce(op: str, vals, seg, num_segments: int, valid=None):
+    if valid is None:
+        valid = jnp.ones(vals.shape[:1], jnp.bool_)
+    if op == "sum":
+        z = jnp.zeros((), vals.dtype)
+        contrib = jnp.where(valid, vals, z)
+        return jax.ops.segment_sum(contrib, seg, num_segments)
+    if op == "min":
+        big = jnp.iinfo(vals.dtype).max if jnp.issubdtype(vals.dtype, jnp.integer) \
+            else jnp.inf
+        contrib = jnp.where(valid, vals, jnp.asarray(big, vals.dtype))
+        return jax.ops.segment_min(contrib, seg, num_segments)
+    if op == "max":
+        small = jnp.iinfo(vals.dtype).min if jnp.issubdtype(vals.dtype, jnp.integer) \
+            else -jnp.inf
+        contrib = jnp.where(valid, vals, jnp.asarray(small, vals.dtype))
+        return jax.ops.segment_max(contrib, seg, num_segments)
+    raise ValueError(op)
+
+
+def _agg_column(col: Column, op: str, order, seg, num_segments: int):
+    """Returns (data, valid_counts) for one aggregation over sorted rows."""
+    sval = None if col.data is None else jnp.take(col.data, order, axis=0)
+    svalid = jnp.take(col.valid_mask(), order)
+    counts = jax.ops.segment_sum(svalid.astype(jnp.int64), seg, num_segments)
+
+    if op == "count":
+        return Column(INT64, data=counts), None
+    if op == "count_all":
+        ones = jnp.ones(order.shape, jnp.int64)
+        return Column(INT64, data=jax.ops.segment_sum(ones, seg, num_segments)), None
+
+    has_any = counts > 0
+    tid = col.dtype.id
+    if op in ("sum", "mean"):
+        if tid == TypeId.FLOAT64:
+            vals = Column(col.dtype, data=sval).float_values()
+        elif tid == TypeId.FLOAT32:
+            vals = jnp.asarray(sval, jnp.float64)
+        elif col.dtype.is_decimal:
+            vals = sval.astype(jnp.int64)  # unscaled sum keeps the scale
+        else:
+            vals = sval.astype(jnp.int64)  # Spark widens integral sums to long
+        s = _segment_reduce("sum", vals, seg, num_segments, svalid)
+        if op == "mean":
+            m = s.astype(jnp.float64) / jnp.maximum(counts, 1).astype(jnp.float64)
+            if col.dtype.is_decimal:
+                m = m * (10.0 ** col.dtype.scale)
+            return Column.fixed(FLOAT64, m, validity=has_any), None
+        if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return Column.fixed(FLOAT64, s, validity=has_any), None
+        out_dtype = col.dtype if col.dtype.is_decimal else INT64
+        return Column(out_dtype, data=s, validity=has_any), None
+
+    if op in ("min", "max"):
+        if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
+            # exact on the total-order encoding, decode via gather of argmin
+            enc = _order._fixed_to_u64(Column(col.dtype, data=sval))
+            enc = jnp.where(svalid, enc,
+                            jnp.where(op == "min", jnp.uint64(2**64 - 1),
+                                      jnp.uint64(0)))
+            red = _segment_reduce(op, enc.astype(jnp.uint64), seg, num_segments)
+            # invert the order transform
+            if tid == TypeId.FLOAT64:
+                sign = (red & (jnp.uint64(1) << jnp.uint64(63))) != 0
+                bits = jnp.where(sign, red ^ (jnp.uint64(1) << jnp.uint64(63)),
+                                 ~red)
+                data = bits.astype(jnp.int64)
+                return Column(col.dtype, data=data, validity=has_any), None
+            sign = (red & jnp.uint64(0x80000000)) != 0
+            bits32 = jnp.where(sign, red ^ jnp.uint64(0x80000000),
+                               ~red & jnp.uint64(0xFFFFFFFF))
+            data = jax.lax.bitcast_convert_type(
+                bits32.astype(jnp.uint32), jnp.float32)
+            return Column(col.dtype, data=data, validity=has_any), None
+        red = _segment_reduce(op, sval, seg, num_segments, svalid)
+        return Column(col.dtype, data=red, validity=has_any), None
+
+    raise ValueError(f"unknown aggregation {op!r}; expected one of {AGGS}")
+
+
+def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
+                   keys_cols: list | None = None):
+    """Jit-able core: (key_table_padded, agg_table_padded, ngroups).
+
+    Outputs have n rows; rows >= ngroups are padding.  Strings in VALUE
+    position are unsupported (as in cudf hash aggregations).
+    """
+    key_cols = keys_cols if keys_cols is not None else \
+        [table.column(k) for k in key_names]
+    skeys = [SortKey(c) for c in key_cols]
+    order, seg, ngroups = _seg_ids(skeys)
+    n = order.shape[0]
+
+    first_row_of_seg = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg, n)  # n-padded
+    out_keys = []
+    for c in key_cols:
+        if c.dtype.is_string:
+            from .strings_common import to_padded_bytes
+            mat, lengths = to_padded_bytes(c)
+            srt = jnp.take(order, jnp.clip(first_row_of_seg, 0, n - 1))
+            gm = jnp.take(mat, srt, axis=0)
+            gl = jnp.take(lengths, srt)
+            out_keys.append(("string", gm, gl,
+                             jnp.take(c.valid_mask(), srt)))
+        else:
+            srt = jnp.take(order, jnp.clip(first_row_of_seg, 0, n - 1))
+            data = jnp.take(c.data, srt, axis=0)
+            valid = jnp.take(c.valid_mask(), srt)
+            out_keys.append(("fixed", c.dtype, data, valid))
+
+    out_aggs = []
+    for col_ref, op in aggs:
+        col = table.column(col_ref) if not isinstance(col_ref, Column) else col_ref
+        if col.dtype.is_string and op != "count" and op != "count_all":
+            raise TypeError("string value aggregation not supported")
+        sort_col = Column(col.dtype, data=col.data, validity=col.validity,
+                          offsets=col.offsets, children=col.children)
+        if col.dtype.is_string:
+            # count only: data buffer irrelevant
+            svalid = jnp.take(col.valid_mask(), order)
+            counts = jax.ops.segment_sum(svalid.astype(jnp.int64), seg, n)
+            if op == "count_all":
+                counts = jax.ops.segment_sum(
+                    jnp.ones((n,), jnp.int64), seg, n)
+            out_aggs.append(Column(INT64, data=counts))
+        else:
+            out_aggs.append(_agg_column(sort_col, op, order, seg, n)[0])
+    return out_keys, out_aggs, ngroups
+
+
+def groupby(table: Table, key_names: list, aggs: list[tuple],
+            names: list | None = None) -> Table:
+    """GROUP BY key_names with aggregations [(column, op), ...] -> compact Table.
+
+    op in {sum, min, max, mean, count, count_all}.
+    """
+    out_keys, out_aggs, ngroups = groupby_padded(table, key_names, aggs)
+    ng = int(ngroups)
+    cols = []
+    for spec in out_keys:
+        if spec[0] == "string":
+            _, gm, gl, gv = spec
+            gm, gl, gv = (np.asarray(gm)[:ng], np.asarray(gl)[:ng],
+                          np.asarray(gv)[:ng])
+            from .strings_common import from_padded_bytes
+            has_null = not gv.all()
+            cols.append(from_padded_bytes(gm, gl, gv if has_null else None))
+        else:
+            _, dtype, data, valid = spec
+            v = np.asarray(valid)[:ng]
+            cols.append(Column(dtype, data=jnp.asarray(np.asarray(data)[:ng]),
+                               validity=jnp.asarray(v) if not v.all() else None))
+    for c in out_aggs:
+        data = jnp.asarray(np.asarray(c.data)[:ng])
+        valid = None if c.validity is None else \
+            jnp.asarray(np.asarray(c.validity)[:ng])
+        cols.append(Column(c.dtype, data=data, validity=valid))
+    key_names_out = [k if isinstance(k, str) else f"key{i}"
+                     for i, k in enumerate(key_names)]
+    agg_names = names or [
+        f"{op}_{ref if isinstance(ref, str) else i}"
+        for i, (ref, op) in enumerate(aggs)]
+    return Table(cols, key_names_out + list(agg_names))

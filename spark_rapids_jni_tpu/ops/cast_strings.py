@@ -269,7 +269,8 @@ def cast_to_decimal(col: Column, dtype: DType, ansi: bool = False) -> Column:
     scaled_up = p["digits"] * jnp.where(mul_ovf, _U64(1), mul)
     q = scaled_up // div
     r = scaled_up % div
-    q = q + jnp.where((shift < 0) & (r * _U64(2) >= div), _U64(1), _U64(0))
+    # HALF_UP without u64 overflow: r*2 >= div  <=>  r >= div - r  (r < div)
+    q = q + jnp.where((shift < 0) & (r >= div - r), _U64(1), _U64(0))
     q = jnp.where((shift > 19) & (p["digits"] > _U64(0)), umax, q)  # overflow
 
     q = jnp.where(shift < -19, _U64(0), q)  # rounds to zero well below scale

@@ -1,0 +1,213 @@
+"""Equi-joins: inner / left / left-semi / left-anti (+ SortMergeJoin surface).
+
+TPU-native replacement for cudf's hash joins (the SortMergeJoin/ShuffledHashJoin
+targets in BASELINE.json configs[3]).  Open-addressing hash tables don't
+vectorize on TPU; instead:
+
+    1. key each side with xxhash64 over the join columns (ops/hash.py)
+    2. sort the build side by hash (radix sort)
+    3. searchsorted(left hashes) -> candidate range [lo, hi) per probe row
+    4. expand ranges to pairs with cumsum offsets + searchsorted inversion
+    5. verify true key equality per pair (hash collisions filtered exactly)
+
+The expansion size is data-dependent (it IS the join cardinality), so pair
+materialization host-syncs one scalar — the same place cudf returns its
+gather-map size.  All heavy work is device-side sort/scan/gather.
+
+Null join keys never match (SQL equi-join semantics), enforced by the
+verification pass; null-safe equality (<=>) is ``null_equal=True``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..dtypes import TypeId
+from .hash import xxhash64
+from .order import normalize_f64_bits, normalize_f32_bits
+from .selection import gather_table
+from .strings_common import to_padded_bytes
+
+_I32 = jnp.int32
+
+
+def _key_table(table: Table, on) -> Table:
+    return Table([table.column(k) for k in on])
+
+
+def _pair_equal(lcol: Column, rcol: Column, li, ri, null_equal: bool):
+    """Per-pair true equality of key values at rows (li, ri)."""
+    lv = jnp.take(lcol.valid_mask(), li)
+    rv = jnp.take(rcol.valid_mask(), ri)
+    if lcol.dtype.is_string:
+        lmat, llen = to_padded_bytes(lcol)
+        rmat, rlen = to_padded_bytes(rcol)
+        w = max(lmat.shape[1], rmat.shape[1])
+        lmat = jnp.pad(lmat, ((0, 0), (0, w - lmat.shape[1])))
+        rmat = jnp.pad(rmat, ((0, 0), (0, w - rmat.shape[1])))
+        eq = jnp.take(llen, li) == jnp.take(rlen, ri)
+        eq = eq & (jnp.take(lmat, li, axis=0)
+                   == jnp.take(rmat, ri, axis=0)).all(axis=1)
+    elif lcol.dtype.id == TypeId.FLOAT64:
+        # compare normalized bit patterns: -0.0 = 0.0, NaN matches NaN
+        # (Spark join-key float normalization)
+        ln = normalize_f64_bits(lcol.data.astype(jnp.uint64))
+        rn = normalize_f64_bits(rcol.data.astype(jnp.uint64))
+        eq = jnp.take(ln, li) == jnp.take(rn, ri)
+    elif lcol.dtype.id == TypeId.FLOAT32:
+        ln = normalize_f32_bits(jax.lax.bitcast_convert_type(
+            jnp.asarray(lcol.data, jnp.float32), jnp.uint32))
+        rn = normalize_f32_bits(jax.lax.bitcast_convert_type(
+            jnp.asarray(rcol.data, jnp.float32), jnp.uint32))
+        eq = jnp.take(ln, li) == jnp.take(rn, ri)
+    else:
+        eq = jnp.take(lcol.data, li) == jnp.take(rcol.data, ri)
+    if null_equal:
+        eq = jnp.where(lv & rv, eq, lv == rv)
+    else:
+        eq = eq & lv & rv
+    return eq
+
+
+def _candidates(left: Table, right: Table, on_left, on_right):
+    """Device candidate ranges + host pair count; returns (li, ri, eq)."""
+    lk = _key_table(left, on_left)
+    rk = _key_table(right, on_right)
+    lh = xxhash64(lk).data
+    rh = xxhash64(rk).data
+
+    r_order = jnp.argsort(rh)
+    rh_sorted = jnp.take(rh, r_order)
+    lo = jnp.searchsorted(rh_sorted, lh, side="left").astype(_I32)
+    hi = jnp.searchsorted(rh_sorted, lh, side="right").astype(_I32)
+    counts = (hi - lo).astype(jnp.int64)
+    offsets = jnp.cumsum(counts)
+    total = int(offsets[-1]) if counts.shape[0] else 0  # host sync: join size
+
+    if total == 0:
+        z = jnp.zeros((0,), _I32)
+        return z, z, jnp.zeros((0,), jnp.bool_), lk, rk
+
+    starts = offsets - counts
+    j = jnp.arange(total, dtype=jnp.int64)
+    li = jnp.searchsorted(offsets, j, side="right").astype(_I32)
+    within = (j - jnp.take(starts, li)).astype(_I32)
+    ri_sorted_pos = jnp.take(lo, li) + within
+    ri = jnp.take(r_order, ri_sorted_pos).astype(_I32)
+
+    eq = jnp.ones((total,), jnp.bool_)
+    for lc, rc in zip(lk.columns, rk.columns):
+        eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
+    return li, ri, eq, lk, rk
+
+
+def _compact_pairs(li, ri, eq):
+    keep = np.flatnonzero(np.asarray(eq))
+    return (jnp.asarray(np.asarray(li)[keep]),
+            jnp.asarray(np.asarray(ri)[keep]))
+
+
+def inner_join(left: Table, right: Table, on_left, on_right=None,
+               suffixes=("", "_r")) -> Table:
+    """Inner equi-join; returns left columns then right non-key columns."""
+    on_right = on_right or on_left
+    li, ri, eq, _, _ = _candidates(left, right, on_left, on_right)
+    li, ri = _compact_pairs(li, ri, eq)
+    return _assemble(left, right, li, ri, on_left, on_right, suffixes,
+                     right_valid=None)
+
+
+def left_join(left: Table, right: Table, on_left, on_right=None,
+              suffixes=("", "_r")) -> Table:
+    on_right = on_right or on_left
+    li, ri, eq, _, _ = _candidates(left, right, on_left, on_right)
+    lin = np.asarray(li)
+    eqn = np.asarray(eq)
+    keep = np.flatnonzero(eqn)
+    matched_rows = np.zeros(left.num_rows, bool)
+    matched_rows[lin[keep]] = True
+    un = np.flatnonzero(~matched_rows)
+    li_all = jnp.asarray(np.concatenate([lin[keep], un]).astype(np.int32))
+    ri_all = jnp.asarray(np.concatenate(
+        [np.asarray(ri)[keep], np.full(un.shape, -1, np.int32)]))
+    return _assemble(left, right, li_all, ri_all, on_left, on_right, suffixes,
+                     right_valid=ri_all >= 0)
+
+
+def _distinct_reps(table: Table, on):
+    """(representative-row index array, group id per row) for the key columns.
+
+    Bounds semi/anti work by |distinct keys| instead of join cardinality —
+    with a hot key, the candidate expansion over raw rows would be quadratic.
+    """
+    from .order import SortKey, encode_keys, rows_differ_from_prev
+    keys = [SortKey(table.column(k)) for k in on]
+    words = encode_keys(keys)
+    order = jnp.lexsort(tuple(reversed(words)))
+    bounds = rows_differ_from_prev(words, order)
+    seg = jnp.cumsum(bounds.astype(_I32)) - 1
+    order_np = np.asarray(order)
+    seg_np = np.asarray(seg)
+    seg_of_row = np.empty_like(seg_np)
+    seg_of_row[order_np] = seg_np
+    reps = order_np[np.asarray(bounds)]
+    return reps.astype(np.int32), seg_of_row
+
+
+def _matched_left_rows(left: Table, right: Table, on_left, on_right):
+    lreps, lseg_of_row = _distinct_reps(left, on_left)
+    rreps, _ = _distinct_reps(right, on_right)
+    knames = [f"k{i}" for i in range(len(on_left))]
+    lrep_t = gather_table(Table([left.column(k) for k in on_left], knames),
+                          jnp.asarray(lreps))
+    rrep_t = gather_table(Table([right.column(k) for k in on_right], knames),
+                          jnp.asarray(rreps))
+    li, ri, eq, _, _ = _candidates(lrep_t, rrep_t, knames, knames)
+    matched_unique = np.zeros(len(lreps), bool)
+    matched_unique[np.asarray(li)[np.flatnonzero(np.asarray(eq))]] = True
+    return matched_unique[lseg_of_row]
+
+
+def left_semi_join(left: Table, right: Table, on_left, on_right=None) -> Table:
+    on_right = on_right or on_left
+    matched = _matched_left_rows(left, right, on_left, on_right)
+    return gather_table(left, jnp.asarray(np.flatnonzero(matched), _I32))
+
+
+def left_anti_join(left: Table, right: Table, on_left, on_right=None) -> Table:
+    on_right = on_right or on_left
+    matched = _matched_left_rows(left, right, on_left, on_right)
+    return gather_table(left, jnp.asarray(np.flatnonzero(~matched), _I32))
+
+
+def _assemble(left, right, li, ri, on_left, on_right, suffixes, right_valid):
+    lcols = gather_table(left, li)
+    rnames = right.names or [f"c{i}" for i in range(right.num_columns)]
+    keep_r = [i for i, nm in enumerate(rnames)
+              if not (isinstance(on_right, (list, tuple)) and nm in on_right)]
+    rsub = Table([right.columns[i] for i in keep_r],
+                 [rnames[i] for i in keep_r])
+    rcols = gather_table(rsub, ri, indices_valid=right_valid)
+    lnames = lcols.names or [f"l{i}" for i in range(lcols.num_columns)]
+    names = list(lnames) + [
+        nm + (suffixes[1] if nm in lnames else "") for nm in rsub.names]
+    return Table(list(lcols.columns) + list(rcols.columns), names)
+
+
+def sort_merge_join(left: Table, right: Table, on_left, on_right=None,
+                    how: str = "inner") -> Table:
+    """SortMergeJoin surface: the exchange plans in BASELINE.json configs[3]
+    name this; physically the same sorted-probe expansion as inner_join."""
+    on_right = on_right or on_left
+    if how == "inner":
+        return inner_join(left, right, on_left, on_right)
+    if how == "left":
+        return left_join(left, right, on_left, on_right)
+    if how == "semi":
+        return left_semi_join(left, right, on_left, on_right)
+    if how == "anti":
+        return left_anti_join(left, right, on_left, on_right)
+    raise ValueError(f"unsupported join type {how!r}")

@@ -1,0 +1,67 @@
+"""Row selection: gather (string-aware), boolean-mask filter, sorting, slicing.
+
+The cudf primitives the reference's op layer builds on (gather with NULLIFY
+out-of-bounds policy, apply_boolean_mask, sorted_order/gather) re-expressed
+for XLA.  Fixed-width gathers are pure device ops; producing a *compacted*
+STRING column requires the new char-buffer size, which is data-dependent, so
+string compaction happens at the host boundary (XLA static shapes).  Inside
+jit pipelines strings travel as padded matrices instead (strings_common).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from .order import SortKey, sort_indices
+from .strings_common import to_padded_bytes, from_padded_bytes
+
+
+def gather_column(col: Column, indices, indices_valid=None) -> Column:
+    """Row gather with cudf NULLIFY semantics; supports STRING columns."""
+    if not col.dtype.is_string:
+        return col.gather(indices, indices_valid)
+    indices = jnp.asarray(indices)
+    mat, lengths = to_padded_bytes(col)
+    n = mat.shape[0]
+    ok = (indices >= 0) & (indices < n)
+    safe = jnp.clip(indices, 0, max(n - 1, 0))
+    gmat = jnp.take(mat, safe, axis=0)
+    glen = jnp.where(ok, jnp.take(lengths, safe), 0)
+    valid = ok
+    if col.validity is not None:
+        valid = valid & jnp.take(col.validity, safe)
+    if indices_valid is not None:
+        valid = valid & indices_valid
+    return from_padded_bytes(gmat, glen, valid)
+
+
+def gather_table(table: Table, indices, indices_valid=None) -> Table:
+    return Table([gather_column(c, indices, indices_valid)
+                  for c in table.columns], table.names)
+
+
+def apply_boolean_mask(table: Table, mask) -> Table:
+    """Keep rows where mask is True (null mask entries drop the row, like
+    Spark filter).  Output size is data-dependent -> host boundary."""
+    if isinstance(mask, Column):
+        m = np.asarray(mask.data).astype(bool) & mask.validity_numpy()
+    else:
+        m = np.asarray(mask).astype(bool)
+    idx = jnp.asarray(np.flatnonzero(m), jnp.int32)
+    return gather_table(table, idx)
+
+
+def sort_table(table: Table, keys: list[SortKey]) -> Table:
+    """cudf sorted_order + gather as one call."""
+    order = sort_indices(keys)
+    return gather_table(table, order)
+
+
+def slice_table(table: Table, start: int, length: int) -> Table:
+    """Row range [start, start+length) clamped to the table (cudf::slice)."""
+    start = max(0, min(start, table.num_rows))
+    length = max(0, min(length, table.num_rows - start))
+    idx = jnp.arange(start, start + length, dtype=jnp.int32)
+    return gather_table(table, idx)

@@ -1,0 +1,273 @@
+"""Relational layer tests: sort, filter, groupby aggregate, joins.
+
+Ground truth via plain python dict/list computations per test.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.order import SortKey, sort_indices
+from spark_rapids_jni_tpu.ops.selection import (
+    apply_boolean_mask, sort_table, gather_table, slice_table)
+from spark_rapids_jni_tpu.ops.aggregate import groupby
+from spark_rapids_jni_tpu.ops.join import (
+    inner_join, left_join, left_semi_join, left_anti_join)
+
+
+# -- sort -------------------------------------------------------------------
+
+def test_sort_single_int_key():
+    c = Column.from_pylist([5, 1, None, 3, 2, None], dt.INT64)
+    t = Table([c], ["x"])
+    out = sort_table(t, [SortKey(c)])
+    assert out["x"].to_pylist() == [None, None, 1, 2, 3, 5]  # nulls first (asc)
+    out_d = sort_table(t, [SortKey(c, ascending=False)])
+    assert out_d["x"].to_pylist() == [5, 3, 2, 1, None, None]  # nulls last
+
+
+def test_sort_multi_key_stable_order():
+    a = Column.from_pylist([1, 2, 1, 2, 1], dt.INT32)
+    b = Column.from_pylist([9.5, 1.5, -3.0, 2.5, 0.0], dt.FLOAT64)
+    t = Table([a, b], ["a", "b"])
+    out = sort_table(t, [SortKey(a), SortKey(b, ascending=False)])
+    assert out["a"].to_pylist() == [1, 1, 1, 2, 2]
+    assert out["b"].to_pylist() == [9.5, 0.0, -3.0, 2.5, 1.5]
+
+
+def test_sort_floats_total_order():
+    vals = [1.5, -np.inf, np.nan, -0.0, 0.0, np.inf, -2.5]
+    c = Column.from_numpy(np.array(vals, np.float64))
+    out = sort_table(Table([c]), [SortKey(c)])
+    got = out.columns[0].to_numpy()
+    # -inf < -2.5 < -0.0 < 0.0 < 1.5 < inf < nan  (cudf/Spark order)
+    assert got[0] == -np.inf and got[1] == -2.5
+    assert got[2] == 0.0 and np.signbit(got[2])
+    assert got[3] == 0.0 and not np.signbit(got[3])
+    assert got[4] == 1.5 and got[5] == np.inf and np.isnan(got[6])
+
+
+def test_sort_strings():
+    c = Column.from_pylist(["pear", "apple", None, "app", "banana", ""])
+    out = sort_table(Table([c]), [SortKey(c)])
+    assert out.columns[0].to_pylist() == \
+        [None, "", "app", "apple", "banana", "pear"]
+
+
+def test_sort_decimal_and_timestamp():
+    c = Column.fixed(dt.decimal64(-2), np.array([500, -100, 0, 250], np.int64))
+    out = sort_table(Table([c]), [SortKey(c)])
+    np.testing.assert_array_equal(out.columns[0].to_numpy(), [-100, 0, 250, 500])
+
+
+# -- filter / gather --------------------------------------------------------
+
+def test_apply_boolean_mask():
+    t = Table.from_pydict({"x": np.arange(6, dtype=np.int64),
+                           "s": ["a", "b", "c", "d", "e", "f"]})
+    mask = Column.from_pylist([True, False, None, True, False, True])
+    out = apply_boolean_mask(t, mask)
+    assert out["x"].to_pylist() == [0, 3, 5]
+    assert out["s"].to_pylist() == ["a", "d", "f"]
+
+
+def test_gather_string_nullify():
+    t = Table.from_pydict({"s": ["x", "y", "z"]})
+    out = gather_table(t, np.array([2, 5, 0, -1], np.int32))
+    assert out["s"].to_pylist() == ["z", None, "x", None]
+
+
+def test_slice():
+    t = Table.from_pydict({"x": np.arange(10, dtype=np.int32)})
+    assert slice_table(t, 3, 4)["x"].to_pylist() == [3, 4, 5, 6]
+
+
+# -- groupby ----------------------------------------------------------------
+
+def test_groupby_sum_count_mean():
+    t = Table.from_pydict({
+        "k": [1, 2, 1, 2, 1, None, None],
+        "v": [10, 20, 30, 40, None, 5, 6],
+    })
+    out = groupby(t, ["k"], [("v", "sum"), ("v", "count"), ("v", "count_all"),
+                             ("v", "mean")])
+    d = {k: (s, c, ca, m) for k, s, c, ca, m in zip(
+        out["k"].to_pylist(), out.columns[1].to_pylist(),
+        out.columns[2].to_pylist(), out.columns[3].to_pylist(),
+        out.columns[4].to_pylist())}
+    assert d[1] == (40, 2, 3, 20.0)
+    assert d[2] == (60, 2, 2, 30.0)
+    assert d[None] == (11, 2, 2, 5.5)  # null keys group together
+
+
+def test_groupby_min_max_floats_exact():
+    t = Table.from_pydict({
+        "k": [1, 1, 1, 2, 2],
+        "v": Column.from_numpy(np.array([1.5, -0.0, np.nan, 1e300, -2.5],
+                                        np.float64)),
+    })
+    out = groupby(t, ["k"], [("v", "min"), ("v", "max")])
+    d = {k: (mn, mx) for k, mn, mx in zip(
+        out["k"].to_pylist(), out.columns[1].to_pylist(),
+        out.columns[2].to_pylist())}
+    # Spark NormalizeFloatingNumbers: -0.0 normalizes to 0.0 in aggregates
+    assert d[1][0] == 0.0 and not np.signbit(d[1][0])
+    assert np.isnan(d[1][1])  # nan sorts greatest, cudf/Spark max semantics
+    assert d[2] == (-2.5, 1e300)  # 1e300 exact via bits storage
+
+
+def test_groupby_string_keys():
+    t = Table.from_pydict({
+        "k": ["a", "bb", "a", None, "bb", "a"],
+        "v": [1, 2, 3, 4, 5, 6],
+    })
+    out = groupby(t, ["k"], [("v", "sum")])
+    d = dict(zip(out["k"].to_pylist(), out.columns[1].to_pylist()))
+    assert d == {"a": 10, "bb": 7, None: 4}
+
+
+def test_groupby_multi_key():
+    t = Table.from_pydict({
+        "a": [1, 1, 2, 2, 1],
+        "b": ["x", "y", "x", "x", "x"],
+        "v": [1, 2, 3, 4, 5],
+    })
+    out = groupby(t, ["a", "b"], [("v", "sum")])
+    d = {(a, b): v for a, b, v in zip(out["a"].to_pylist(),
+                                      out["b"].to_pylist(),
+                                      out.columns[2].to_pylist())}
+    assert d == {(1, "x"): 6, (1, "y"): 2, (2, "x"): 7}
+
+
+def test_groupby_decimal_sum_keeps_scale():
+    t = Table.from_pydict({
+        "k": [1, 1, 2],
+        "v": Column.fixed(dt.decimal64(-2), np.array([150, 250, 100], np.int64)),
+    })
+    out = groupby(t, ["k"], [("v", "sum")])
+    assert out.columns[1].dtype == dt.decimal64(-2)
+    d = dict(zip(out["k"].to_pylist(), np.asarray(out.columns[1].data)))
+    assert d == {1: 400, 2: 100}
+
+
+# -- joins ------------------------------------------------------------------
+
+def test_inner_join_basic():
+    left = Table.from_pydict({"k": [1, 2, 3, 4], "lv": [10, 20, 30, 40]})
+    right = Table.from_pydict({"k": [2, 4, 4, 5], "rv": [200, 400, 401, 500]})
+    out = inner_join(left, right, ["k"])
+    rows = sorted(zip(out["k"].to_pylist(), out["lv"].to_pylist(),
+                      out["rv"].to_pylist()))
+    assert rows == [(2, 20, 200), (4, 40, 400), (4, 40, 401)]
+
+
+def test_left_join_with_nulls():
+    left = Table.from_pydict({"k": [1, 2, None], "lv": [10, 20, 30]})
+    right = Table.from_pydict({"k": [2, None], "rv": [200, 999]})
+    out = left_join(left, right, ["k"])
+    rows = sorted(zip(out["k"].to_pylist(), out["lv"].to_pylist(),
+                      out["rv"].to_pylist()), key=lambda r: r[1])
+    # null keys never match (SQL equi-join)
+    assert rows == [(1, 10, None), (2, 20, 200), (None, 30, None)]
+
+
+def test_semi_anti_join():
+    left = Table.from_pydict({"k": [1, 2, 3, 4], "lv": [1, 2, 3, 4]})
+    right = Table.from_pydict({"k": [2, 2, 4, 7]})
+    semi = left_semi_join(left, right, ["k"])
+    anti = left_anti_join(left, right, ["k"])
+    assert sorted(semi["k"].to_pylist()) == [2, 4]
+    assert sorted(anti["k"].to_pylist()) == [1, 3]
+
+
+def test_join_string_keys():
+    left = Table.from_pydict({"k": ["apple", "pear", "fig"], "lv": [1, 2, 3]})
+    right = Table.from_pydict({"k": ["fig", "apple", "apple"], "rv": [7, 8, 9]})
+    out = inner_join(left, right, ["k"])
+    rows = sorted(zip(out["k"].to_pylist(), out["lv"].to_pylist(),
+                      out["rv"].to_pylist()))
+    assert rows == [("apple", 1, 8), ("apple", 1, 9), ("fig", 3, 7)]
+
+
+def test_join_multi_key():
+    left = Table.from_pydict({"a": [1, 1, 2], "b": ["x", "y", "x"],
+                              "lv": [1, 2, 3]})
+    right = Table.from_pydict({"a": [1, 2, 1], "b": ["x", "x", "z"],
+                               "rv": [10, 20, 30]})
+    out = inner_join(left, right, ["a", "b"])
+    rows = sorted(zip(out["a"].to_pylist(), out["b"].to_pylist(),
+                      out["lv"].to_pylist(), out["rv"].to_pylist()))
+    assert rows == [(1, "x", 1, 10), (2, "x", 3, 20)]
+
+
+def test_join_empty_result():
+    left = Table.from_pydict({"k": [1, 2]})
+    right = Table.from_pydict({"k": [5, 6]})
+    out = inner_join(left, right, ["k"])
+    assert out.num_rows == 0
+
+
+def test_join_large_random_matches_pandas_style():
+    rng = np.random.default_rng(0)
+    lk = rng.integers(0, 50, 500)
+    rk = rng.integers(0, 50, 300)
+    left = Table.from_pydict({"k": lk.astype(np.int64),
+                              "lv": np.arange(500, dtype=np.int64)})
+    right = Table.from_pydict({"k": rk.astype(np.int64),
+                               "rv": np.arange(300, dtype=np.int64)})
+    out = inner_join(left, right, ["k"])
+    got = sorted(zip(out["lv"].to_pylist(), out["rv"].to_pylist()))
+    want = sorted((i, j) for i in range(500) for j in range(300)
+                  if lk[i] == rk[j])
+    assert got == want
+
+
+def test_join_groupby_float_normalization():
+    # Spark float normalization: -0.0 = 0.0 and NaN = NaN for keys
+    left = Table.from_pydict(
+        {"k": Column.from_numpy(np.array([0.0, np.nan], np.float64)),
+         "lv": [1, 2]})
+    right = Table.from_pydict(
+        {"k": Column.from_numpy(np.array([-0.0, np.nan], np.float64)),
+         "rv": [10, 20]})
+    out = inner_join(left, right, ["k"])
+    rows = sorted(zip(out["lv"].to_pylist(), out["rv"].to_pylist()))
+    assert rows == [(1, 10), (2, 20)]
+
+    g = groupby(Table.from_pydict(
+        {"k": Column.from_numpy(np.array([0.0, -0.0, np.nan, np.nan],
+                                         np.float64)),
+         "v": [1, 1, 1, 1]}), ["k"], [("v", "count")])
+    assert g.num_rows == 2
+
+    # float32 keys too
+    lf = Table.from_pydict(
+        {"k": Column.from_numpy(np.array([0.0], np.float32)), "lv": [1]})
+    rf = Table.from_pydict(
+        {"k": Column.from_numpy(np.array([-0.0], np.float32)), "rv": [2]})
+    assert inner_join(lf, rf, ["k"]).num_rows == 1
+
+
+def test_decimal_19_digit_rounding():
+    from spark_rapids_jni_tpu.ops.cast_strings import cast_to_decimal
+    c = cast_to_decimal(Column.from_pylist(["0.9300000000000000000",
+                                            "0.4999999999999999999"]),
+                        dt.decimal64(0))
+    np.testing.assert_array_equal(c.to_numpy(), [1, 0])
+
+
+def test_slice_clamps():
+    t = Table.from_pydict({"x": np.arange(3, dtype=np.int64)})
+    assert slice_table(t, 1, 5)["x"].to_pylist() == [1, 2]
+    assert slice_table(t, 5, 2)["x"].to_pylist() == []
+
+
+def test_semi_join_hot_key_dedup():
+    # hot key on both sides: candidate space must stay tiny via dedup
+    left = Table.from_pydict({"k": np.zeros(5000, np.int64)})
+    right = Table.from_pydict({"k": np.zeros(5000, np.int64)})
+    semi = left_semi_join(left, right, ["k"])
+    assert semi.num_rows == 5000
+    anti = left_anti_join(left, right, ["k"])
+    assert anti.num_rows == 0
