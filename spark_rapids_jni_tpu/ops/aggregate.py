@@ -39,13 +39,26 @@ from . import order as _order
 AGGS = ("sum", "min", "max", "mean", "count", "count_all")
 
 
-def _seg_ids(keys: list[SortKey]):
+def _seg_ids(keys: list[SortKey], row_mask=None):
+    """Sort+segment the rows; masked-out rows sort last as dead groups.
+
+    With ``row_mask`` (padded pipelines, e.g. post-shuffle), the returned
+    ``ngroups`` counts only live groups — dead rows sort after every live row
+    via a primary mask word, so live groups occupy seg ids [0, ngroups).
+    """
     words = encode_keys(keys)
+    if row_mask is not None:
+        words = [(~row_mask).astype(jnp.uint64)] + words  # live rows first
     order = jnp.lexsort(tuple(reversed(words)))
     bounds = rows_differ_from_prev(words, order)
     seg = jnp.cumsum(bounds.astype(jnp.int32)) - 1
-    ngroups = jnp.where(order.shape[0] > 0, seg[-1] + 1, 0) \
-        if order.shape[0] else jnp.int32(0)
+    if order.shape[0] == 0:
+        return order, seg, jnp.int32(0)
+    if row_mask is None:
+        ngroups = seg[-1] + 1
+    else:
+        live_sorted = jnp.take(row_mask, order)
+        ngroups = jnp.sum((bounds & live_sorted).astype(jnp.int32))
     return order, seg, ngroups
 
 
@@ -69,17 +82,26 @@ def _segment_reduce(op: str, vals, seg, num_segments: int, valid=None):
     raise ValueError(op)
 
 
-def _agg_column(col: Column, op: str, order, seg, num_segments: int):
-    """Returns (data, valid_counts) for one aggregation over sorted rows."""
+def _agg_column(col: Column, op: str, order, seg, num_segments: int,
+                live_sorted=None):
+    """One aggregation over sorted rows.
+
+    ``live_sorted``: sorted-order live-row mask for padded pipelines; the
+    single place dead rows are excluded from every op, count_all included.
+    """
+    if op == "count_all":
+        live = jnp.ones(order.shape, jnp.int64) if live_sorted is None \
+            else live_sorted.astype(jnp.int64)
+        return Column(INT64, data=jax.ops.segment_sum(live, seg, num_segments))
+
     sval = None if col.data is None else jnp.take(col.data, order, axis=0)
     svalid = jnp.take(col.valid_mask(), order)
+    if live_sorted is not None:
+        svalid = svalid & live_sorted
     counts = jax.ops.segment_sum(svalid.astype(jnp.int64), seg, num_segments)
 
     if op == "count":
-        return Column(INT64, data=counts), None
-    if op == "count_all":
-        ones = jnp.ones(order.shape, jnp.int64)
-        return Column(INT64, data=jax.ops.segment_sum(ones, seg, num_segments)), None
+        return Column(INT64, data=counts)
 
     has_any = counts > 0
     tid = col.dtype.id
@@ -97,11 +119,11 @@ def _agg_column(col: Column, op: str, order, seg, num_segments: int):
             m = s.astype(jnp.float64) / jnp.maximum(counts, 1).astype(jnp.float64)
             if col.dtype.is_decimal:
                 m = m * (10.0 ** col.dtype.scale)
-            return Column.fixed(FLOAT64, m, validity=has_any), None
+            return Column.fixed(FLOAT64, m, validity=has_any)
         if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
-            return Column.fixed(FLOAT64, s, validity=has_any), None
+            return Column.fixed(FLOAT64, s, validity=has_any)
         out_dtype = col.dtype if col.dtype.is_decimal else INT64
-        return Column(out_dtype, data=s, validity=has_any), None
+        return Column(out_dtype, data=s, validity=has_any)
 
     if op in ("min", "max"):
         if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
@@ -117,21 +139,21 @@ def _agg_column(col: Column, op: str, order, seg, num_segments: int):
                 bits = jnp.where(sign, red ^ (jnp.uint64(1) << jnp.uint64(63)),
                                  ~red)
                 data = bits.astype(jnp.int64)
-                return Column(col.dtype, data=data, validity=has_any), None
+                return Column(col.dtype, data=data, validity=has_any)
             sign = (red & jnp.uint64(0x80000000)) != 0
             bits32 = jnp.where(sign, red ^ jnp.uint64(0x80000000),
                                ~red & jnp.uint64(0xFFFFFFFF))
             data = jax.lax.bitcast_convert_type(
                 bits32.astype(jnp.uint32), jnp.float32)
-            return Column(col.dtype, data=data, validity=has_any), None
+            return Column(col.dtype, data=data, validity=has_any)
         red = _segment_reduce(op, sval, seg, num_segments, svalid)
-        return Column(col.dtype, data=red, validity=has_any), None
+        return Column(col.dtype, data=red, validity=has_any)
 
     raise ValueError(f"unknown aggregation {op!r}; expected one of {AGGS}")
 
 
 def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
-                   keys_cols: list | None = None):
+                   keys_cols: list | None = None, row_mask=None):
     """Jit-able core: (key_table_padded, agg_table_padded, ngroups).
 
     Outputs have n rows; rows >= ngroups are padding.  Strings in VALUE
@@ -140,7 +162,7 @@ def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
     key_cols = keys_cols if keys_cols is not None else \
         [table.column(k) for k in key_names]
     skeys = [SortKey(c) for c in key_cols]
-    order, seg, ngroups = _seg_ids(skeys)
+    order, seg, ngroups = _seg_ids(skeys, row_mask)
     n = order.shape[0]
 
     first_row_of_seg = jax.ops.segment_min(
@@ -161,23 +183,21 @@ def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
             valid = jnp.take(c.valid_mask(), srt)
             out_keys.append(("fixed", c.dtype, data, valid))
 
+    live_sorted = None if row_mask is None else jnp.take(row_mask, order)
     out_aggs = []
     for col_ref, op in aggs:
         col = table.column(col_ref) if not isinstance(col_ref, Column) else col_ref
-        if col.dtype.is_string and op != "count" and op != "count_all":
+        if col.dtype.is_string and op not in ("count", "count_all"):
             raise TypeError("string value aggregation not supported")
-        sort_col = Column(col.dtype, data=col.data, validity=col.validity,
-                          offsets=col.offsets, children=col.children)
-        if col.dtype.is_string:
-            # count only: data buffer irrelevant
+        if col.dtype.is_string and op == "count":
+            # no fixed-width buffer to gather; count validity directly
             svalid = jnp.take(col.valid_mask(), order)
-            counts = jax.ops.segment_sum(svalid.astype(jnp.int64), seg, n)
-            if op == "count_all":
-                counts = jax.ops.segment_sum(
-                    jnp.ones((n,), jnp.int64), seg, n)
-            out_aggs.append(Column(INT64, data=counts))
+            if live_sorted is not None:
+                svalid = svalid & live_sorted
+            out_aggs.append(Column(INT64, data=jax.ops.segment_sum(
+                svalid.astype(jnp.int64), seg, n)))
         else:
-            out_aggs.append(_agg_column(sort_col, op, order, seg, n)[0])
+            out_aggs.append(_agg_column(col, op, order, seg, n, live_sorted))
     return out_keys, out_aggs, ngroups
 
 

@@ -1,0 +1,12 @@
+"""Parallel layer: device meshes, hash-partition shuffle, distributed plans.
+
+The single biggest net-new component vs the reference (SURVEY.md §2.3): the
+reference defers all cross-worker exchange to Spark's shuffle at L6, only
+*preparing* row blobs for it (RowConversion.java:28-31).  Here the exchange is
+first-class: row blobs ride ``jax.lax.all_to_all`` over the ICI mesh inside
+``shard_map``, so a whole shuffle+aggregate plan compiles to one XLA program.
+"""
+
+from .mesh import make_mesh, shard_table  # noqa: F401
+from .shuffle import shuffle_table_padded, partition_ids  # noqa: F401
+from .distributed import distributed_groupby  # noqa: F401

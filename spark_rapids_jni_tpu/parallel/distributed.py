@@ -1,0 +1,208 @@
+"""Distributed query plans: shuffle-then-aggregate, shuffle-then-join.
+
+The classic Spark physical plan for GROUP BY — partial aggregation, hash
+exchange, final aggregation (what spark-rapids runs as GpuHashAggregate +
+GpuShuffleExchange) — expressed as ONE jittable XLA program over the mesh:
+
+    local groupby_padded  ->  row-blob all_to_all  ->  final groupby_padded
+
+Everything stays in HBM; the exchange rides ICI.  Outputs are padded per
+shard (static shapes) with a live-row mask; ``distributed_groupby`` compacts
+at the host boundary, ``distributed_groupby_padded`` is the pure function for
+pjit pipelines (the dryrun/benchmark entry).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..columnar import Column, Table
+from ..dtypes import DType, TypeId, INT64, FLOAT64
+from ..ops.aggregate import groupby_padded
+from ..ops.row_conversion import fixed_width_layout, _to_row_words, \
+    _from_row_words
+from .mesh import ROW_AXIS
+from .shuffle import partition_ids, _bucket_scatter
+
+# (partial op emitted by the local pass, final re-aggregation op)
+_REAGG = {"sum": "sum", "count": "sum", "count_all": "sum",
+          "min": "min", "max": "max"}
+
+
+def _expand_aggs(aggs):
+    """mean decomposes into (sum, count) partials + a final divide."""
+    partial_specs = []   # (col_ref, op) for the local pass
+    final_plan = []      # ("direct", partial_idx, final_op) | ("mean", si, ci)
+    for ref, op in aggs:
+        if op == "mean":
+            si = len(partial_specs)
+            partial_specs.append((ref, "sum"))
+            ci = len(partial_specs)
+            partial_specs.append((ref, "count"))
+            final_plan.append(("mean", si, ci))
+        else:
+            i = len(partial_specs)
+            partial_specs.append((ref, op))
+            final_plan.append(("direct", i, _REAGG[op]))
+    return partial_specs, final_plan
+
+
+def _padded_table(out_keys, out_aggs, key_names):
+    cols, names = [], []
+    for spec, nm in zip(out_keys, key_names):
+        if spec[0] == "string":
+            raise TypeError("string keys not supported in the distributed "
+                            "path yet (dictionary-encode first)")
+        _, dtype, data, valid = spec
+        cols.append(Column(dtype, data=data, validity=valid))
+        names.append(nm if isinstance(nm, str) else f"key{nm}")
+    for i, c in enumerate(out_aggs):
+        cols.append(c)
+        names.append(f"agg{i}")
+    return Table(cols, names)
+
+
+def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
+                              key_names: tuple, aggs: tuple,
+                              capacity: int, axis: str = ROW_AXIS):
+    """Compile-once distributed GROUP BY for a fixed schema.
+
+    Returns fn(datas, masks) -> (key+agg padded buffers, live mask, ngroups
+    per shard, overflow) operating on row-sharded column buffers.
+    """
+    ndev = mesh.shape[axis]
+    partial_specs, final_plan = _expand_aggs(aggs)
+
+    def shard_fn(datas, masks):
+        shard_tbl = Table([Column(dt, data=d, validity=m)
+                           for dt, d, m in zip(schema, datas, masks)],
+                          list(names))
+        # 1. local partial aggregation (padded to shard rows)
+        out_keys, out_aggs, ng_local = groupby_padded(
+            shard_tbl, list(key_names), list(partial_specs))
+        n_local = shard_tbl.num_rows
+        live_local = jnp.arange(n_local, dtype=jnp.int32) < ng_local
+
+        partial_tbl = _padded_table(out_keys, out_aggs, key_names)
+        playout = fixed_width_layout(partial_tbl.dtypes())
+        pdatas = tuple(c.data for c in partial_tbl.columns)
+        pmasks = tuple(c.validity for c in partial_tbl.columns)
+
+        # 2. exchange partial groups by key hash (row blobs over ICI)
+        key_cols = [partial_tbl.column(i) for i in range(len(key_names))]
+        dest = partition_ids(Table(key_cols), ndev)
+        rows = _to_row_words(playout, pdatas, pmasks)
+        send, ok, overflow = _bucket_scatter(rows, dest, live_local, ndev,
+                                             capacity)
+        recv = jax.lax.all_to_all(send, axis, 0, 0)
+        rok = jax.lax.all_to_all(ok, axis, 0, 0)
+        rows_in = recv.reshape(ndev * capacity, rows.shape[1])
+        mask_in = rok.reshape(ndev * capacity)
+
+        # 3. final aggregation over received partials
+        rdatas, rmasks = _from_row_words(playout, rows_in)
+        rtbl = Table([Column(dt, data=d, validity=m) for dt, d, m in
+                      zip(playout.schema, rdatas, rmasks)],
+                     list(partial_tbl.names))
+        final_specs = []
+        for plan in final_plan:
+            if plan[0] == "mean":
+                final_specs.append((f"agg{plan[1]}", "sum"))
+                final_specs.append((f"agg{plan[2]}", "sum"))
+            else:
+                final_specs.append((f"agg{plan[1]}", plan[2]))
+        fkeys, faggs, ng = groupby_padded(rtbl, list(key_names), final_specs,
+                                          row_mask=mask_in)
+
+        # 4. assemble outputs; resolve means
+        out_cols = []
+        fi = 0
+        for plan in final_plan:
+            if plan[0] == "mean":
+                s, c = faggs[fi], faggs[fi + 1]
+                fi += 2
+                sv = s.float_values() if s.dtype.id == TypeId.FLOAT64 \
+                    else s.data.astype(jnp.float64)
+                m = sv / jnp.maximum(c.data, 1).astype(jnp.float64)
+                valid = (c.data > 0) if s.validity is None \
+                    else (s.validity & (c.data > 0))
+                out_cols.append(Column.fixed(FLOAT64, m, validity=valid))
+            else:
+                out_cols.append(faggs[fi])
+                fi += 1
+        # arrays only across the shard_map boundary (dtypes are static,
+        # reconstructed by the caller from the plan)
+        key_data = tuple(spec[2] for spec in fkeys)
+        key_valid = tuple(spec[3] for spec in fkeys)
+        agg_data = tuple(c.data for c in out_cols)
+        agg_valid = tuple(c.valid_mask() for c in out_cols)
+        live_out = jnp.arange(ndev * capacity, dtype=jnp.int32) < ng
+        return (key_data, key_valid, agg_data, agg_valid, live_out,
+                jnp.reshape(ng, (1,)), jax.lax.psum(overflow, axis))
+
+    spec = P(axis)
+    return shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec, spec),
+        out_specs=(spec, spec, spec, spec, spec, spec, P()),
+        check_vma=False)
+
+
+def agg_out_dtype(col_dtype: DType, op: str) -> DType:
+    """Result dtype of an aggregation (mirrors ops.aggregate._agg_column)."""
+    if op in ("count", "count_all"):
+        return INT64
+    if op == "mean":
+        return FLOAT64
+    if op in ("min", "max"):
+        return col_dtype
+    if op == "sum":
+        if col_dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return FLOAT64
+        return col_dtype if col_dtype.is_decimal else INT64
+    raise ValueError(op)
+
+
+def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
+                        aggs: list, capacity: int | None = None,
+                        axis: str = ROW_AXIS) -> Table:
+    """GROUP BY over a row-sharded table; compacts to a host-side Table."""
+    ndev = mesh.shape[axis]
+    if table.num_rows % ndev:
+        raise ValueError("pad the table to a mesh-divisible row count first "
+                         "(parallel.mesh.pad_to_multiple)")
+    if capacity is None:
+        capacity = table.num_rows // ndev
+    fn = build_distributed_groupby(
+        mesh, tuple(table.dtypes()),
+        tuple(table.names or [f"c{i}" for i in range(table.num_columns)]),
+        tuple(key_names), tuple(aggs), capacity, axis)
+    datas = tuple(c.data for c in table.columns)
+    masks = tuple(c.validity for c in table.columns)
+    (key_data, key_valid, agg_data, agg_valid, live, _ng,
+     overflow) = jax.jit(fn)(datas, masks)
+    if int(overflow) > 0:
+        raise RuntimeError(
+            f"shuffle capacity overflow ({int(overflow)} rows); rerun with "
+            f"larger capacity (got {capacity})")
+
+    live_np = np.asarray(live)
+    key_dtypes = [table.column(k).dtype for k in key_names]
+    agg_dtypes = [agg_out_dtype(table.column(ref).dtype, op)
+                  for ref, op in aggs]
+    cols = []
+    names = list(key_names) + [f"{op}_{ref}" for ref, op in aggs]
+    for dtype, data, valid in zip(
+            key_dtypes + agg_dtypes,
+            list(key_data) + list(agg_data),
+            list(key_valid) + list(agg_valid)):
+        d = np.asarray(data)[live_np]
+        v = np.asarray(valid)[live_np]
+        cols.append(Column(dtype, data=jnp.asarray(d),
+                           validity=None if v.all() else jnp.asarray(v)))
+    return Table(cols, names)

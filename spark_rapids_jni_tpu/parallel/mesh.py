@@ -1,0 +1,61 @@
+"""Mesh construction and table sharding.
+
+Data parallelism in the reference is Spark's task-per-partition with one GPU
+per executor bound by ``auto_set_device`` (reference RowConversionJni.cpp:30).
+The TPU-native form: one global mesh, every column a ``jax.Array`` sharded on
+the row axis, XLA inserting ICI collectives (SURVEY.md §2.3 DP row).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..columnar import Column, Table
+
+ROW_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = ROW_AXIS) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def pad_to_multiple(table: Table, multiple: int) -> tuple[Table, int]:
+    """Pad row count to a mesh-divisible size with null rows; returns original n.
+
+    The SQL analog of the reference's 32-row batch alignment
+    (row_conversion.cu:477-479): shards must be equal-sized for pjit.
+    """
+    n = table.num_rows
+    target = (n + multiple - 1) // multiple * multiple
+    if target == n:
+        return table, n
+    pad = target - n
+    cols = []
+    for c in table.columns:
+        if c.dtype.is_string:
+            raise TypeError("pad_to_multiple: shard STRING columns via "
+                            "dictionary encoding first")
+        data = jnp.concatenate([c.data, jnp.zeros((pad,), c.data.dtype)])
+        valid = jnp.concatenate([c.valid_mask(), jnp.zeros((pad,), jnp.bool_)])
+        cols.append(Column(c.dtype, data=data, validity=valid))
+    return Table(cols, table.names), n
+
+
+def shard_table(table: Table, mesh: Mesh, axis: str = ROW_AXIS) -> Table:
+    """Place every column buffer row-sharded over the mesh axis."""
+    sharding = NamedSharding(mesh, P(axis))
+    cols = []
+    for c in table.columns:
+        if c.dtype.is_string:
+            raise TypeError("shard_table: STRING columns don't row-shard "
+                            "(offsets are n+1); dictionary-encode first")
+        data = jax.device_put(c.data, sharding)
+        valid = None if c.validity is None else \
+            jax.device_put(c.validity, sharding)
+        cols.append(Column(c.dtype, data=data, validity=valid))
+    return Table(cols, table.names)
