@@ -1,0 +1,123 @@
+"""BloomFilter: Spark BloomFilterImpl-compatible build/probe.
+
+TPU-native rebuild of the reference's BloomFilter component (BASELINE.json
+north-star set; CUDA side appears post-snapshot as bloom_filter.cu backing
+Spark 3.3+ runtime filter pushdown: BloomFilterAggregate on the build side,
+BloomFilterMightContain on the probe side).
+
+Spark's BloomFilterImpl (double hashing, sign-folded):
+
+    h1 = Murmur3_x86_32.hashLong(item, seed=0)
+    h2 = Murmur3_x86_32.hashLong(item, seed=h1)
+    for i in 1..k:  pos = fold(h1 + i*h2) % num_bits ; set bit pos
+    fold(x) = ~x if x < 0 else x
+
+The filter state is a device bool[num_bits] array (scatter-friendly form);
+``spark_serialize``/``spark_deserialize`` convert to/from Spark's exact wire
+bytes (V1 header + big-endian longs of the BitArray) so filters interchange
+with JVM executors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Column, Table
+from ..dtypes import BOOL8, TypeId
+from .hash import _murmur_long, _U32
+
+_I32 = jnp.int32
+
+
+def optimal_num_bits(expected_items: int, fpp: float = 0.03) -> int:
+    """Spark BloomFilter.optimalNumOfBits."""
+    return max(8, int(-expected_items * np.log(fpp) / (np.log(2) ** 2)))
+
+
+def optimal_num_hashes(expected_items: int, num_bits: int) -> int:
+    """Spark BloomFilter.optimalNumOfHashFunctions."""
+    return max(1, int(round(num_bits / max(expected_items, 1) * np.log(2))))
+
+
+def _item_u64(col: Column) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(lo, hi) u32 words of the long item + per-row validity."""
+    if not (col.dtype.is_integral or col.dtype.is_timestamp
+            or col.dtype.is_decimal or col.dtype.id == TypeId.BOOL8):
+        raise TypeError(f"bloom filter items must be long-typed, got {col.dtype!r}")
+    v = col.data.astype(jnp.int64)
+    pair = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    return pair[..., 0], pair[..., 1], col.valid_mask()
+
+
+def _positions(col: Column, num_hashes: int, num_bits: int):
+    """[n, num_hashes] int32 bit positions per item (Spark double hashing)."""
+    lo, hi, valid = _item_u64(col)
+    h1 = _murmur_long(lo, hi, _U32(0))
+    h2 = _murmur_long(lo, hi, h1)
+    h1s = jax.lax.bitcast_convert_type(h1, jnp.int32)
+    h2s = jax.lax.bitcast_convert_type(h2, jnp.int32)
+    pos = []
+    for i in range(1, num_hashes + 1):
+        combined = h1s + jnp.int32(i) * h2s  # wraps like Java int
+        combined = jnp.where(combined < 0, ~combined, combined)
+        pos.append(combined % jnp.int32(num_bits))
+    return jnp.stack(pos, axis=1), valid
+
+
+def bloom_build(col: Column, num_bits: int, num_hashes: int) -> jnp.ndarray:
+    """Aggregate a long column into a bool[num_bits] filter (null items skipped)."""
+    pos, valid = _positions(col, num_hashes, num_bits)
+    bits = jnp.zeros((num_bits,), jnp.bool_)
+    pos = jnp.where(valid[:, None], pos, num_bits)  # nulls scatter out of range
+    return bits.at[pos.reshape(-1)].set(True, mode="drop")
+
+
+def bloom_merge(filters: list[jnp.ndarray]) -> jnp.ndarray:
+    """OR-combine filters built with identical (num_bits, num_hashes)."""
+    out = filters[0]
+    for f in filters[1:]:
+        out = out | f
+    return out
+
+
+def bloom_might_contain(bits: jnp.ndarray, col: Column,
+                        num_hashes: int) -> Column:
+    """BOOL8 probe column; null items probe to null (Spark MightContain)."""
+    num_bits = bits.shape[0]
+    pos, valid = _positions(col, num_hashes, num_bits)
+    hit = jnp.take(bits, pos, axis=0).all(axis=1)
+    return Column(BOOL8, data=hit.astype(jnp.uint8),
+                  validity=None if col.validity is None else valid)
+
+
+# -- Spark wire format ------------------------------------------------------
+
+def spark_serialize(bits: np.ndarray, num_hashes: int) -> bytes:
+    """Spark BloomFilterImpl.writeTo: V1, numHashFunctions, numWords, BE longs.
+
+    BitArray layout: bit i lives at words[i >> 6], bit position (i & 63)
+    counting from the long's LSB; longs serialize big-endian (DataOutputStream).
+    """
+    bits = np.asarray(bits).astype(bool)
+    num_bits = bits.shape[0]
+    nwords = (num_bits + 63) // 64
+    padded = np.zeros(nwords * 64, bool)
+    padded[:num_bits] = bits
+    words = np.packbits(padded.reshape(nwords, 64), axis=1,
+                        bitorder="little").view(np.uint64).reshape(nwords)
+    head = np.array([1, num_hashes, nwords], ">i4").tobytes()
+    return head + words.astype(">u8").tobytes()
+
+
+def spark_deserialize(buf: bytes) -> tuple[np.ndarray, int]:
+    """(bool bit array, num_hashes) from Spark BloomFilterImpl bytes."""
+    head = np.frombuffer(buf[:12], ">i4")
+    version, num_hashes, nwords = int(head[0]), int(head[1]), int(head[2])
+    if version != 1:
+        raise ValueError(f"unsupported bloom filter version {version}")
+    words = np.frombuffer(buf[12:12 + nwords * 8], ">u8")
+    bits = np.unpackbits(words.astype("<u8").view(np.uint8),
+                         bitorder="little")  # LSB-first within each long
+    return bits.astype(bool), num_hashes

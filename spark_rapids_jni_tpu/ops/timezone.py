@@ -1,0 +1,138 @@
+"""TimeZoneDB: timezone-aware timestamp conversion from device transition tables.
+
+TPU-native rebuild of the reference's GpuTimeZoneDB component (BASELINE.json
+north-star set; Java/CUDA side appears post-snapshot as GpuTimeZoneDB.java —
+it loads each zone's transition rules into a device table once, then kernels
+binary-search per row).  Same design here:
+
+- host side: parse the system TZif database (/usr/share/zoneinfo, the same
+  IANA data the JVM uses) into (transition instants, utc offsets) int64
+  arrays, cached per zone;
+- device side: ``searchsorted`` into the transition instants picks each row's
+  offset — the vectorized form of the reference's per-thread binary search.
+
+Semantics match Spark's from_utc_timestamp/to_utc_timestamp: timestamps are
+micros since epoch; local->UTC resolves gaps/overlaps by using the offset in
+force *before* the wall-clock transition point (Java's earlier-offset rule
+for overlaps).  Transitions cover what the TZif tables enumerate (through
+2037 for rule-based zones; the trailing POSIX TZ string is not expanded —
+post-2037 rule-based conversions reuse the last known offset).
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column
+from ..dtypes import TypeId
+
+_TZPATHS = ("/usr/share/zoneinfo", "/usr/lib/zoneinfo", "/etc/zoneinfo")
+
+MICROS = 1_000_000
+
+
+def _read_tzif(name: str) -> bytes:
+    if "/" in name and name.startswith("/"):
+        path_candidates = [name]
+    else:
+        path_candidates = [f"{p}/{name}" for p in _TZPATHS]
+    for p in path_candidates:
+        try:
+            with open(p, "rb") as f:
+                return f.read()
+        except OSError:
+            continue
+    raise ValueError(f"unknown timezone {name!r}")
+
+
+@functools.lru_cache(maxsize=None)
+def load_transitions(name: str) -> tuple[np.ndarray, np.ndarray]:
+    """(instants int64[T] seconds-UTC, offsets int64[T] seconds) for a zone.
+
+    ``offsets[i]`` is in force from ``instants[i]`` (inclusive) to
+    ``instants[i+1]``; ``instants[0]`` is -inf sentinel carrying the earliest
+    known offset.
+    """
+    raw = _read_tzif(name)
+    if raw[:4] != b"TZif":
+        raise ValueError(f"{name!r}: not a TZif file")
+    version = raw[4:5]
+
+    def parse_block(buf, off, time_size, time_fmt):
+        (isutcnt, isstdcnt, leapcnt, timecnt, typecnt, charcnt) = \
+            struct.unpack(">6I", buf[off + 20:off + 44])
+        p = off + 44
+        times = np.frombuffer(buf, dtype=time_fmt, count=timecnt, offset=p)
+        p += timecnt * time_size
+        idx = np.frombuffer(buf, dtype=np.uint8, count=timecnt, offset=p)
+        p += timecnt
+        ttinfo = []
+        for i in range(typecnt):
+            utoff, isdst, abbrind = struct.unpack(
+                ">iBB", buf[p + 6 * i:p + 6 * i + 6])
+            ttinfo.append(utoff)
+        p += 6 * typecnt + charcnt + leapcnt * (time_size + 4)
+        p += isstdcnt + isutcnt
+        return times.astype(np.int64), idx, np.array(ttinfo, np.int64), p
+
+    if version >= b"2":
+        # skip the v1 block, parse the 64-bit v2 block
+        _, _, _, end_v1 = parse_block(raw, 0, 4, ">i4")
+        times, idx, offsets_by_type, _ = parse_block(raw, end_v1, 8, ">i8")
+    else:
+        times, idx, offsets_by_type, _ = parse_block(raw, 0, 4, ">i4")
+
+    if offsets_by_type.size == 0:
+        raise ValueError(f"{name!r}: no time types")
+    first = offsets_by_type[0]
+    if times.size:
+        instants = np.concatenate([[np.iinfo(np.int64).min // 2],
+                                   times]).astype(np.int64)
+        offs = np.concatenate([[first], offsets_by_type[idx]]).astype(np.int64)
+    else:
+        instants = np.array([np.iinfo(np.int64).min // 2], np.int64)
+        offs = np.array([first], np.int64)
+    return instants, offs
+
+
+@functools.lru_cache(maxsize=None)
+def _device_tables(name: str):
+    instants, offs = load_transitions(name)
+    return jnp.asarray(instants * MICROS), jnp.asarray(offs * MICROS)
+
+
+def _check_ts(col: Column):
+    if col.dtype.id != TypeId.TIMESTAMP_MICROSECONDS:
+        raise TypeError(
+            f"expected TIMESTAMP_MICROSECONDS, got {col.dtype!r}")
+
+
+def utc_to_local(col: Column, zone: str) -> Column:
+    """Spark from_utc_timestamp: shift a UTC instant to the zone's wall clock."""
+    _check_ts(col)
+    instants, offs = _device_tables(zone)
+    idx = jnp.searchsorted(instants, col.data, side="right") - 1
+    out = col.data + jnp.take(offs, idx)
+    return Column(col.dtype, data=out, validity=col.validity)
+
+
+def local_to_utc(col: Column, zone: str) -> Column:
+    """Spark to_utc_timestamp: interpret wall-clock micros in the zone.
+
+    Gap/overlap resolution: the offset in force before the wall-clock
+    transition wins (Java earlier-offset rule).
+    """
+    _check_ts(col)
+    instants_np, offs_np = load_transitions(zone)
+    # wall-clock instants at which each post-transition offset takes effect
+    wall = instants_np * MICROS + offs_np * MICROS
+    wall_dev = jnp.asarray(wall)
+    offs_dev = jnp.asarray(offs_np * MICROS)
+    idx = jnp.searchsorted(wall_dev, col.data, side="right") - 1
+    idx = jnp.clip(idx, 0, wall_dev.shape[0] - 1)
+    out = col.data - jnp.take(offs_dev, idx)
+    return Column(col.dtype, data=out, validity=col.validity)

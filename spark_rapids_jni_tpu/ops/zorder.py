@@ -1,0 +1,70 @@
+"""ZOrder: bit interleaving for multi-dimensional clustering.
+
+TPU-native rebuild of the reference's ZOrder component (BASELINE.json
+north-star set; CUDA side appears post-snapshot as src/main/cpp/src/zorder.cu
+backing Delta/Databricks OPTIMIZE ZORDER BY through spark-rapids'
+``interleaveBits``).  Semantics: for k integer columns of width w bits, output
+row r is a k*w-bit big-endian byte string where output bit t (MSB-first)
+carries bit (w-1 - t//k) of column (t % k) — identical to the Java/CUDA
+``interleave_bits``.
+
+Everything is shifts/masks on the VPU; the output is a LIST<INT8> column of
+fixed k*w/8-byte rows (offsets are an arithmetic sequence, like the row-blob
+columns from RowConversion).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Column, Table
+from ..dtypes import INT8, TypeId
+
+_WIDTH_OK = {1, 2, 4, 8}
+
+
+def interleave_bits(table: Table) -> Column:
+    """Interleave the bits of equal-width integer columns, MSB-first.
+
+    All columns must share one storage width (cudf interleave_bits requires
+    equal element widths).  Null values interleave their data bytes as-is
+    (the reference kernel reads the data buffer unconditionally).
+    """
+    cols = list(table.columns)
+    if not cols:
+        raise ValueError("interleave_bits needs at least one column")
+    widths = {c.dtype.itemsize for c in cols}
+    if len(widths) != 1 or cols[0].dtype.itemsize not in _WIDTH_OK:
+        raise TypeError(f"columns must share one integer width, got {widths}")
+    for c in cols:
+        if not (c.dtype.is_integral or c.dtype.is_timestamp
+                or c.dtype.id == TypeId.BOOL8 or c.dtype.is_decimal):
+            raise TypeError(f"non-integer column in interleave_bits: {c.dtype!r}")
+    w = cols[0].dtype.itemsize * 8
+    k = len(cols)
+    n = cols[0].size
+
+    # work in u64 lanes (exact for every width on TPU's emulated u64)
+    vals = [c.data.astype(jnp.int64).astype(jnp.uint64)
+            if c.dtype.itemsize == 8 else
+            c.data.astype(jnp.uint64) if c.dtype.storage.kind == "u" else
+            jax.lax.bitcast_convert_type(
+                c.data.astype(jnp.int64), jnp.uint64)
+            for c in cols]
+
+    total_bits = k * w
+    nbytes = total_bits // 8
+    out_bytes = []
+    for byte_i in range(nbytes):
+        acc = jnp.zeros((n,), jnp.uint32)
+        for j in range(8):
+            t = byte_i * 8 + j            # output bit index, MSB-first
+            col = t % k
+            bit = w - 1 - t // k          # source bit, MSB-first per column
+            b = ((vals[col] >> jnp.uint64(bit)) & jnp.uint64(1)).astype(jnp.uint32)
+            acc = acc | (b << jnp.uint32(7 - j))
+        out_bytes.append(acc.astype(jnp.uint8))
+    data = jnp.stack(out_bytes, axis=1).reshape(-1)
+    offsets = jnp.arange(n + 1, dtype=jnp.int32) * nbytes
+    return Column.list_(Column.fixed(INT8, data), offsets)

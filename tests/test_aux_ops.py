@@ -1,0 +1,195 @@
+"""ZOrder, BloomFilter, TimeZoneDB tests with independent ground truth."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.zorder import interleave_bits
+from spark_rapids_jni_tpu.ops.bloom_filter import (
+    bloom_build, bloom_merge, bloom_might_contain, optimal_num_bits,
+    optimal_num_hashes, spark_serialize, spark_deserialize)
+from spark_rapids_jni_tpu.ops.timezone import (
+    utc_to_local, local_to_utc, load_transitions)
+
+
+# -- zorder -----------------------------------------------------------------
+
+def py_interleave(vals, width_bits):
+    """Reference bit interleaver: MSB-first round robin across columns."""
+    k = len(vals)
+    bits = []
+    for t in range(k * width_bits):
+        col = t % k
+        bit = width_bits - 1 - t // k
+        bits.append((int(vals[col]) >> bit) & 1)
+    out = bytearray()
+    for i in range(0, len(bits), 8):
+        b = 0
+        for j in range(8):
+            b = (b << 1) | bits[i + j]
+        out.append(b)
+    return bytes(out)
+
+
+def test_interleave_two_int32():
+    a = np.array([0b1010, -1, 0, 7], np.int32)
+    b = np.array([0b0101, 0, -1, 9], np.int32)
+    t = Table([Column.from_numpy(a), Column.from_numpy(b)])
+    out = interleave_bits(t)
+    raw = np.asarray(out.children[0].data).view(np.uint8).reshape(4, 8)
+    for i in range(4):
+        want = py_interleave([int(a[i]) & 0xFFFFFFFF, int(b[i]) & 0xFFFFFFFF], 32)
+        assert raw[i].tobytes() == want, i
+
+
+def test_interleave_three_int64():
+    rng = np.random.default_rng(0)
+    a, b, c = (rng.integers(-2**62, 2**62, 5).astype(np.int64) for _ in range(3))
+    t = Table([Column.from_numpy(a), Column.from_numpy(b), Column.from_numpy(c)])
+    out = interleave_bits(t)
+    assert np.asarray(out.offsets)[-1] == 5 * 24
+    raw = np.asarray(out.children[0].data).view(np.uint8).reshape(5, 24)
+    for i in range(3):
+        want = py_interleave([int(a[i]) & (2**64 - 1), int(b[i]) & (2**64 - 1),
+                              int(c[i]) & (2**64 - 1)], 64)
+        assert raw[i].tobytes() == want
+
+
+def test_interleave_single_column_identity_bytes():
+    a = np.array([0x0102030405060708], np.int64)
+    out = interleave_bits(Table([Column.from_numpy(a)]))
+    # k=1: big-endian byte dump of the value
+    assert np.asarray(out.children[0].data).view(np.uint8).tobytes() == \
+        a.astype(">i8").tobytes()
+
+
+def test_interleave_rejects_mixed_width():
+    t = Table([Column.from_numpy(np.zeros(2, np.int32)),
+               Column.from_numpy(np.zeros(2, np.int64))])
+    with pytest.raises(TypeError):
+        interleave_bits(t)
+
+
+# -- bloom filter -----------------------------------------------------------
+
+def py_bloom_positions(item, num_hashes, num_bits):
+    import sys
+    sys.path.insert(0, "tests")
+    from test_hash import py_murmur_long
+    M32 = 0xFFFFFFFF
+
+    def to_i32(u):
+        return u - (1 << 32) if u >= (1 << 31) else u
+    h1 = to_i32(py_murmur_long(item & (2**64 - 1), 0))
+    h2 = to_i32(py_murmur_long(item & (2**64 - 1), h1 & M32))
+    pos = []
+    for i in range(1, num_hashes + 1):
+        c = to_i32((h1 + i * h2) & M32)
+        if c < 0:
+            c = ~c
+        pos.append(c % num_bits)
+    return pos
+
+
+def test_bloom_build_probe_spark_semantics():
+    items = np.array([1, 42, -7, 2**62, 0], np.int64)
+    num_bits, k = 1024, 3
+    bits = np.asarray(bloom_build(Column.from_numpy(items), num_bits, k))
+    want = np.zeros(num_bits, bool)
+    for it in items:
+        for p in py_bloom_positions(int(it), k, num_bits):
+            want[p] = True
+    np.testing.assert_array_equal(bits, want)
+
+    probe = Column.from_numpy(np.array([1, 42, -7, 2**62, 0, 99999, -12345],
+                                       np.int64))
+    got = bloom_might_contain(np.asarray(bits), probe, k).to_pylist()
+    assert got[:5] == [True] * 5  # no false negatives ever
+    for v, g in zip([99999, -12345], got[5:]):
+        want_hit = all(want[p] for p in py_bloom_positions(v, k, num_bits))
+        assert g == want_hit
+
+
+def test_bloom_nulls():
+    col = Column.from_pylist([5, None, 7], dt.INT64)
+    bits = bloom_build(col, 256, 2)
+    # the null contributed nothing
+    bits2 = bloom_build(Column.from_pylist([5, 7], dt.INT64), 256, 2)
+    np.testing.assert_array_equal(np.asarray(bits), np.asarray(bits2))
+    got = bloom_might_contain(bits, col, 2).to_pylist()
+    assert got == [True, None, True]
+
+
+def test_bloom_merge_and_wire_roundtrip():
+    a = bloom_build(Column.from_pylist([1, 2, 3], dt.INT64), 512, 3)
+    b = bloom_build(Column.from_pylist([1000, 2000], dt.INT64), 512, 3)
+    m = bloom_merge([a, b])
+    buf = spark_serialize(np.asarray(m), 3)
+    assert buf[:4] == b"\x00\x00\x00\x01"  # V1 big-endian
+    bits, k = spark_deserialize(buf)
+    assert k == 3
+    np.testing.assert_array_equal(bits[:512], np.asarray(m))
+    got = bloom_might_contain(np.asarray(m), Column.from_pylist(
+        [1, 2000, 777777], dt.INT64), 3).to_pylist()
+    assert got[0] and got[1]
+
+
+def test_bloom_sizing_helpers():
+    nb = optimal_num_bits(1000, 0.03)
+    nh = optimal_num_hashes(1000, nb)
+    assert 6000 < nb < 9000  # ~7300 for 3% fpp
+    assert 3 <= nh <= 7
+
+
+# -- timezone ---------------------------------------------------------------
+
+def to_micros(*args):
+    from datetime import datetime, timezone
+    return int(datetime(*args, tzinfo=timezone.utc).timestamp() * 1_000_000)
+
+
+@pytest.mark.parametrize("zone", ["America/New_York", "Asia/Tokyo",
+                                  "Australia/Sydney", "Europe/Paris"])
+def test_utc_to_local_matches_zoneinfo(zone):
+    from datetime import datetime, timezone
+    from zoneinfo import ZoneInfo
+    z = ZoneInfo(zone)
+    stamps = [
+        (2020, 1, 15, 12, 0, 0), (2020, 7, 15, 12, 0, 0),
+        (2021, 3, 14, 6, 30, 0), (2021, 11, 7, 5, 30, 0),
+        (1999, 12, 31, 23, 59, 59), (2036, 6, 1, 0, 0, 0),
+    ]
+    micros = np.array([to_micros(*s) for s in stamps], np.int64)
+    col = Column.fixed(dt.TIMESTAMP_MICROSECONDS, micros)
+    got = np.asarray(utc_to_local(col, zone).data)
+    for m, g, s in zip(micros, got, stamps):
+        utc_dt = datetime(*s, tzinfo=timezone.utc)
+        off = z.utcoffset(utc_dt.astimezone(z)).total_seconds()
+        assert g - m == off * 1_000_000, (zone, s, g - m, off)
+
+
+def test_local_to_utc_roundtrip_unambiguous():
+    zone = "America/New_York"
+    stamps = [(2020, 1, 15, 12, 0, 0), (2020, 7, 15, 12, 0, 0)]
+    micros = np.array([to_micros(*s) for s in stamps], np.int64)
+    col = Column.fixed(dt.TIMESTAMP_MICROSECONDS, micros)
+    local = utc_to_local(col, zone)
+    back = local_to_utc(local, zone)
+    np.testing.assert_array_equal(np.asarray(back.data), micros)
+
+
+def test_load_transitions_sane():
+    instants, offs = load_transitions("America/New_York")
+    assert len(instants) == len(offs) > 100
+    assert (np.diff(instants) > 0).all()
+    # EST/EDT offsets present
+    assert -5 * 3600 in offs and -4 * 3600 in offs
+
+
+def test_fixed_offset_zone():
+    instants, offs = load_transitions("Etc/GMT+5")  # = UTC-5, no DST
+    col = Column.fixed(dt.TIMESTAMP_MICROSECONDS,
+                       np.array([to_micros(2020, 6, 1, 0, 0, 0)], np.int64))
+    got = np.asarray(utc_to_local(col, "Etc/GMT+5").data)
+    assert got[0] - col.data[0] == -5 * 3600 * 1_000_000
