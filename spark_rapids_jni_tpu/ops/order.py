@@ -111,6 +111,10 @@ def encode_key(key: SortKey) -> list[jnp.ndarray]:
     if not key.ascending:
         words = [~wd for wd in words]
     if col.validity is not None:
+        # neutralize value words on null rows: whatever bytes the buffer holds
+        # there must not split the null group (SQL: all nulls compare equal in
+        # GROUP BY) or order rows within the null block
+        words = [jnp.where(col.validity, wd, _U64(0)) for wd in words]
         flag = col.validity.astype(_U64)  # valid=1: nulls first
         if not key.effective_nulls_first:
             flag = _U64(1) - flag

@@ -219,8 +219,13 @@ def convert_to_rows(table: Table, max_batch_bytes: int = MAX_BATCH_BYTES) -> lis
     n = table.num_rows
     rows_per_batch = max(1, max_batch_bytes // layout.row_size)
     if rows_per_batch < n:
-        rows_per_batch = max(BATCH_ROW_ALIGN,
-                             rows_per_batch // BATCH_ROW_ALIGN * BATCH_ROW_ALIGN)
+        if layout.row_size * BATCH_ROW_ALIGN > max_batch_bytes:
+            # a 32-row-aligned batch would exceed the cap (and for the default
+            # cap, overflow the int32 LIST offsets the format protects)
+            raise ValueError(
+                f"row size {layout.row_size} too large: a {BATCH_ROW_ALIGN}"
+                f"-row aligned batch exceeds max_batch_bytes={max_batch_bytes}")
+        rows_per_batch = rows_per_batch // BATCH_ROW_ALIGN * BATCH_ROW_ALIGN
     out = []
     start = 0
     while start < n or (n == 0 and not out):

@@ -60,8 +60,15 @@ def from_padded_bytes(mat: jnp.ndarray, lengths: jnp.ndarray,
     mat = np.asarray(mat)
     lengths = np.asarray(lengths).astype(np.int64)
     n = mat.shape[0]
-    offsets = np.zeros(n + 1, np.int32)
-    np.cumsum(lengths, out=offsets[1:])
+    offsets64 = np.zeros(n + 1, np.int64)
+    np.cumsum(lengths, out=offsets64[1:])
+    if offsets64[-1] > np.iinfo(np.int32).max:
+        # cudf raises on string offset overflow; a silent int32 wrap here
+        # would corrupt the Arrow offsets
+        raise OverflowError(
+            f"string column char buffer is {int(offsets64[-1])} bytes; "
+            f"Arrow int32 offsets cap at 2^31-1")
+    offsets = offsets64.astype(np.int32)
     keep = np.arange(mat.shape[1])[None, :] < lengths[:, None]
     chars = mat[keep]  # row-major boolean extraction == concatenated rows
     return Column.string(chars, offsets, validity)

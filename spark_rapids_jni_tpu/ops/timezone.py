@@ -33,6 +33,7 @@ from ..dtypes import TypeId
 _TZPATHS = ("/usr/share/zoneinfo", "/usr/lib/zoneinfo", "/etc/zoneinfo")
 
 MICROS = 1_000_000
+_SENTINEL = np.iinfo(np.int64).min // 2  # below any representable micros
 
 
 def _read_tzif(name: str) -> bytes:
@@ -90,11 +91,10 @@ def load_transitions(name: str) -> tuple[np.ndarray, np.ndarray]:
         raise ValueError(f"{name!r}: no time types")
     first = offsets_by_type[0]
     if times.size:
-        instants = np.concatenate([[np.iinfo(np.int64).min // 2],
-                                   times]).astype(np.int64)
+        instants = np.concatenate([[_SENTINEL], times]).astype(np.int64)
         offs = np.concatenate([[first], offsets_by_type[idx]]).astype(np.int64)
     else:
-        instants = np.array([np.iinfo(np.int64).min // 2], np.int64)
+        instants = np.array([_SENTINEL], np.int64)
         offs = np.array([first], np.int64)
     return instants, offs
 
@@ -102,7 +102,24 @@ def load_transitions(name: str) -> tuple[np.ndarray, np.ndarray]:
 @functools.lru_cache(maxsize=None)
 def _device_tables(name: str):
     instants, offs = load_transitions(name)
-    return jnp.asarray(instants * MICROS), jnp.asarray(offs * MICROS)
+    # Scale only the real transitions: the -2^62 sentinel times 10^6 is a
+    # multiple of 2^64 and wraps to 0, unsorting the table and breaking
+    # searchsorted.  The sentinel stays pre-scaled (it is already below any
+    # micros value).
+    scaled = np.concatenate([[_SENTINEL], instants[1:] * MICROS])
+    return jnp.asarray(scaled), jnp.asarray(offs * MICROS)
+
+
+@functools.lru_cache(maxsize=None)
+def _device_wall_tables(name: str):
+    """Cached (wall-clock transition instants, offsets) in micros for a zone.
+
+    ``wall[i]`` is the local wall-clock micros at which ``offs[i]`` takes
+    effect; sentinel stays pre-scaled (see _device_tables on int64 wrap).
+    """
+    instants, offs = load_transitions(name)
+    wall = np.concatenate([[_SENTINEL], instants[1:] * MICROS + offs[1:] * MICROS])
+    return jnp.asarray(wall), jnp.asarray(offs * MICROS)
 
 
 def _check_ts(col: Column):
@@ -115,7 +132,8 @@ def utc_to_local(col: Column, zone: str) -> Column:
     """Spark from_utc_timestamp: shift a UTC instant to the zone's wall clock."""
     _check_ts(col)
     instants, offs = _device_tables(zone)
-    idx = jnp.searchsorted(instants, col.data, side="right") - 1
+    idx = jnp.clip(jnp.searchsorted(instants, col.data, side="right") - 1,
+                   0, None)  # pre-sentinel timestamps take the earliest offset
     out = col.data + jnp.take(offs, idx)
     return Column(col.dtype, data=out, validity=col.validity)
 
@@ -127,11 +145,7 @@ def local_to_utc(col: Column, zone: str) -> Column:
     transition wins (Java earlier-offset rule).
     """
     _check_ts(col)
-    instants_np, offs_np = load_transitions(zone)
-    # wall-clock instants at which each post-transition offset takes effect
-    wall = instants_np * MICROS + offs_np * MICROS
-    wall_dev = jnp.asarray(wall)
-    offs_dev = jnp.asarray(offs_np * MICROS)
+    wall_dev, offs_dev = _device_wall_tables(zone)
     idx = jnp.searchsorted(wall_dev, col.data, side="right") - 1
     idx = jnp.clip(idx, 0, wall_dev.shape[0] - 1)
     out = col.data - jnp.take(offs_dev, idx)

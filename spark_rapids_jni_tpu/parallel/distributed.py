@@ -70,11 +70,17 @@ def _padded_table(out_keys, out_aggs, key_names):
 
 def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
                               key_names: tuple, aggs: tuple,
-                              capacity: int, axis: str = ROW_AXIS):
+                              capacity: int, axis: str = ROW_AXIS,
+                              n_valid: int | None = None):
     """Compile-once distributed GROUP BY for a fixed schema.
 
     Returns fn(datas, masks) -> (key+agg padded buffers, live mask, ngroups
     per shard, overflow) operating on row-sharded column buffers.
+
+    ``n_valid``: original (pre-padding) global row count.  Rows at global
+    index >= n_valid are pad_to_multiple null rows and are masked out of the
+    local partial pass — without this they would form a spurious null-key
+    group and corrupt genuine null-key aggregates.
     """
     ndev = mesh.shape[axis]
     partial_specs, final_plan = _expand_aggs(aggs)
@@ -83,10 +89,20 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
         shard_tbl = Table([Column(dt, data=d, validity=m)
                            for dt, d, m in zip(schema, datas, masks)],
                           list(names))
+        n_local = shard_tbl.num_rows
+        if n_valid is None:
+            row_mask = None
+        else:
+            # shards are contiguous row ranges: shard i owns global rows
+            # [i * n_local, (i+1) * n_local)
+            shard_idx = jax.lax.axis_index(axis).astype(jnp.int64)
+            global_row = shard_idx * n_local + jnp.arange(n_local,
+                                                          dtype=jnp.int64)
+            row_mask = global_row < n_valid
         # 1. local partial aggregation (padded to shard rows)
         out_keys, out_aggs, ng_local = groupby_padded(
-            shard_tbl, list(key_names), list(partial_specs))
-        n_local = shard_tbl.num_rows
+            shard_tbl, list(key_names), list(partial_specs),
+            row_mask=row_mask)
         live_local = jnp.arange(n_local, dtype=jnp.int32) < ng_local
 
         partial_tbl = _padded_table(out_keys, out_aggs, key_names)
@@ -170,18 +186,29 @@ def agg_out_dtype(col_dtype: DType, op: str) -> DType:
 
 def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
                         aggs: list, capacity: int | None = None,
-                        axis: str = ROW_AXIS) -> Table:
-    """GROUP BY over a row-sharded table; compacts to a host-side Table."""
+                        axis: str = ROW_AXIS,
+                        n_valid_rows: int | None = None) -> Table:
+    """GROUP BY over a row-sharded table; compacts to a host-side Table.
+
+    Non-mesh-divisible tables are padded internally with masked null rows.
+    Callers who pre-padded with ``pad_to_multiple`` must pass the original
+    row count as ``n_valid_rows`` so padding rows don't aggregate as data.
+    """
+    from .mesh import pad_to_multiple, shard_table
     ndev = mesh.shape[axis]
     if table.num_rows % ndev:
-        raise ValueError("pad the table to a mesh-divisible row count first "
-                         "(parallel.mesh.pad_to_multiple)")
+        if n_valid_rows is not None:
+            raise ValueError("table rows not mesh-divisible; pad first or "
+                             "let distributed_groupby pad (omit n_valid_rows)")
+        table, n_valid_rows = pad_to_multiple(table, ndev)
+        table = shard_table(table, mesh, axis)
     if capacity is None:
         capacity = table.num_rows // ndev
     fn = build_distributed_groupby(
         mesh, tuple(table.dtypes()),
         tuple(table.names or [f"c{i}" for i in range(table.num_columns)]),
-        tuple(key_names), tuple(aggs), capacity, axis)
+        tuple(key_names), tuple(aggs), capacity, axis,
+        n_valid=n_valid_rows)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
     (key_data, key_valid, agg_data, agg_valid, live, _ng,

@@ -193,3 +193,32 @@ def test_fixed_offset_zone():
                        np.array([to_micros(2020, 6, 1, 0, 0, 0)], np.int64))
     got = np.asarray(utc_to_local(col, "Etc/GMT+5").data)
     assert got[0] - col.data[0] == -5 * 3600 * 1_000_000
+
+
+def test_pre_first_transition_uses_earliest_offset():
+    """ADVICE r1: the -2^62 sentinel * 1e6 wrapped int64, unsorting the device
+    table; timestamps before a zone's first transition took the LAST offset."""
+    from datetime import datetime, timezone
+    from zoneinfo import ZoneInfo
+    zone = "America/New_York"
+    # 1700-01-01: long before the zone's first TZif transition (LMT era)
+    micros = np.array(
+        [int(datetime(1700, 1, 1, tzinfo=timezone.utc).timestamp() * 1e6)],
+        np.int64)
+    col = Column.fixed(dt.TIMESTAMP_MICROSECONDS, micros)
+    got = np.asarray(utc_to_local(col, zone).data)
+    z = ZoneInfo(zone)
+    off = z.utcoffset(
+        datetime(1700, 1, 1, tzinfo=timezone.utc).astimezone(z)
+    ).total_seconds()
+    assert got[0] - micros[0] == off * 1_000_000
+    # local -> utc round trip in the LMT era too
+    back = local_to_utc(Column.fixed(dt.TIMESTAMP_MICROSECONDS, got), zone)
+    np.testing.assert_array_equal(np.asarray(back.data), micros)
+
+
+def test_device_transition_table_sorted():
+    from spark_rapids_jni_tpu.ops.timezone import _device_tables
+    inst, _ = _device_tables("America/New_York")
+    inst = np.asarray(inst)
+    assert (np.diff(inst) > 0).all()

@@ -127,6 +127,52 @@ def test_pad_to_multiple(mesh):
     assert padded["x"].validity_numpy()[10:].sum() == 0
 
 
+def test_distributed_groupby_non_divisible_rows(mesh):
+    """ADVICE r1 high: padding rows must not aggregate as a null-key group."""
+    n = 10  # pads to 16 on the 8-device mesh
+    k = np.array([1, 1, 2, 2, 2, 3, 3, 3, 3, 1], np.int64)
+    v = np.arange(n, dtype=np.int64)
+    t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+    got = distributed_groupby(t, mesh, ["k"], [("v", "sum"), ("v", "count_all")])
+    want = groupby(t, ["k"], [("v", "sum"), ("v", "count_all")])
+    gd = {r[0]: r[1:] for r in zip(*[c.to_pylist() for c in got.columns])}
+    wd = {r[0]: r[1:] for r in zip(*[c.to_pylist() for c in want.columns])}
+    assert gd == wd
+    assert None not in gd  # no spurious null-key group from padding
+
+
+def test_distributed_groupby_padding_vs_real_null_keys(mesh):
+    """Genuine null-key groups must not absorb padding-row counts."""
+    n = 11  # pads to 16
+    k = np.arange(n, dtype=np.int64) % 3
+    kvalid = np.array([True] * 8 + [False] * 3)
+    t = Table([Column.from_numpy(k, validity=kvalid),
+               Column.from_numpy(np.ones(n, np.int64))], ["k", "v"])
+    got = distributed_groupby(t, mesh, ["k"], [("v", "count_all")])
+    want = groupby(t, ["k"], [("v", "count_all")])
+    # all nulls form ONE group (dict(zip) would silently collapse duplicates)
+    assert got["k"].to_pylist().count(None) == 1
+    assert want["k"].to_pylist().count(None) == 1
+    gd = dict(zip(got["k"].to_pylist(), got.columns[1].to_pylist()))
+    wd = dict(zip(want["k"].to_pylist(), want.columns[1].to_pylist()))
+    assert gd == wd
+    assert gd[None] == 3  # exactly the real null-key rows
+
+
+def test_distributed_groupby_prepadded_with_n_valid(mesh):
+    n = 10
+    t = Table([Column.from_numpy(np.arange(n, dtype=np.int64) % 4),
+               Column.from_numpy(np.ones(n, np.int64))], ["k", "v"])
+    padded, n_orig = pad_to_multiple(t, NDEV)
+    st = shard_table(padded, mesh)
+    got = distributed_groupby(st, mesh, ["k"], [("v", "sum")],
+                              n_valid_rows=n_orig)
+    want = groupby(t, ["k"], [("v", "sum")])
+    gd = dict(zip(got["k"].to_pylist(), got.columns[1].to_pylist()))
+    wd = dict(zip(want["k"].to_pylist(), want.columns[1].to_pylist()))
+    assert gd == wd
+
+
 def test_float64_exact_through_shuffle(mesh):
     vals = np.array([np.pi, 1e300, -0.0, 5e-324] * 64, np.float64)
     t = Table([Column.from_numpy(np.arange(256, dtype=np.int64) % 8),

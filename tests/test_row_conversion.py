@@ -169,6 +169,22 @@ def test_from_rows_rejects_non_list():
         convert_from_rows(c, [dt.INT64])
 
 
+def test_batch_align_cannot_exceed_cap():
+    """ADVICE r1: forcing 32-row alignment must not silently exceed the cap."""
+    t = Table([Column.from_numpy(np.arange(64, dtype=np.int64))])
+    lay = fixed_width_layout(t.dtypes())
+    with pytest.raises(ValueError):
+        convert_to_rows(t, max_batch_bytes=16 * lay.row_size)  # < 32 rows/batch
+
+
+def test_from_padded_bytes_rejects_int32_offset_overflow():
+    from spark_rapids_jni_tpu.ops.strings_common import from_padded_bytes
+    mat = np.zeros((3, 4), np.uint8)
+    lengths = np.array([2**30, 2**30, 2**30], np.int64)  # sums past 2^31
+    with pytest.raises(OverflowError):
+        from_padded_bytes(mat, lengths)
+
+
 def test_jit_to_rows_traceable():
     """The kernel path stays inside one jit (no host sync per column)."""
     lay = fixed_width_layout([dt.INT64, dt.FLOAT64])
