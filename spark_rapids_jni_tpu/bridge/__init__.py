@@ -1,0 +1,25 @@
+"""Bridge layer: JVM/native <-> TPU device-server FFI.
+
+The reference's defining discipline is that bulk data never crosses its FFI —
+only 64-bit handles do (reference RowConversionJni.cpp:31,36 unwraps a jlong
+to a ``cudf::table_view*`` and returns released column handles).  A JVM and
+the TPU runtime cannot share one address space the way JNI+CUDA do, so the
+handle table moves into a long-lived *device server* process per host
+(SURVEY.md §7 "Architecture translation"):
+
+- ``server``  — the device-server: owns a HandleTable of Table/Column ids
+  naming jax.Arrays resident in HBM; speaks a length-prefixed command
+  protocol over a Unix domain socket.  Every op call carries handles only.
+- ``client``  — pure-Python client (testing/debugging peer of the C ABI).
+- ``protocol``— shared wire constants/framing.
+
+Bulk host columns cross exactly once, at import/export, through POSIX shared
+memory in Arrow layout (data buffer + byte-per-row validity) — the zero-copy
+staging the reference gets from ``RapidsHostColumnVector`` pinned buffers.
+The native half lives in ``src/main/cpp`` (libtpubridge, C ABI + gated JNI
+adapter) with the Java surface in ``src/main/java``.
+"""
+
+from .client import BridgeClient, spawn_server
+
+__all__ = ["BridgeClient", "spawn_server"]

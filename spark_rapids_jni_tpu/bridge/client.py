@@ -1,0 +1,201 @@
+"""Pure-Python bridge client — reference peer of the native libtpubridge.
+
+Implements exactly the wire exchanges the C ABI in
+``src/main/cpp/src/tpubridge.cpp`` performs, so server behavior can be
+tested without the native build, and discrepancies between the two clients
+localize the bug.  Host tables stage through a client-created shm segment in
+Arrow layout; everything after import is handle traffic.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from . import protocol as P
+from . import shm as shmlib
+from ..columnar import Column, Table
+from ..dtypes import DType, TypeId
+
+
+def spawn_server(sock_path: str, env: dict | None = None,
+                 timeout: float = 60.0) -> subprocess.Popen:
+    """Start a device-server subprocess and wait for its socket."""
+    e = dict(os.environ)
+    # default the server onto CPU unless the caller says otherwise — a second
+    # process contending for a one-tenant TPU tunnel hangs at backend init
+    e.setdefault("JAX_PLATFORMS", "cpu")
+    # make the package importable regardless of the caller's cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    e["PYTHONPATH"] = pkg_root + os.pathsep + e.get("PYTHONPATH", "")
+    if env:
+        e.update(env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_jni_tpu.bridge.server",
+         "--socket", sock_path], env=e)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"bridge server died (rc={proc.returncode})")
+        if os.path.exists(sock_path):
+            try:
+                c = BridgeClient(sock_path)
+                c.ping()
+                c.close()
+                return proc
+            except (ConnectionError, OSError):
+                pass
+        time.sleep(0.05)
+    proc.kill()
+    raise TimeoutError("bridge server did not come up")
+
+
+class BridgeClient:
+    def __init__(self, sock_path: str):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(sock_path)
+        self._imp_counter = 0
+
+    # -- plumbing ----------------------------------------------------------
+    def _call(self, opcode: int, payload: bytes = b"") -> bytes:
+        P.send_msg(self.sock, opcode, payload)
+        status, body = P.recv_msg(self.sock)
+        if status != P.STATUS_OK:
+            raise RuntimeError(f"bridge error: {body.decode()}")
+        return body
+
+    def ping(self) -> None:
+        if self._call(P.OP_PING) != b"pong":  # not an assert: must run under -O
+            raise RuntimeError("bridge server returned a bad ping reply")
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def shutdown_server(self) -> None:
+        self._call(P.OP_SHUTDOWN)
+        self.close()
+
+    # -- handle ops ----------------------------------------------------------
+    def import_table(self, table: Table) -> int:
+        """Stage a host table through shm; returns its device handle."""
+        self._imp_counter += 1
+        name = f"tpub-imp-{os.getpid()}-{self._imp_counter}"
+        seg = shmlib.SegmentWriter(name)
+        descs = []
+        for c in table.columns:
+            hasv = c.validity is not None
+            voff = vlen = 0
+            if hasv:
+                voff, vlen = seg.add(
+                    c.validity_numpy().astype(np.uint8).tobytes())
+            if c.dtype.is_string:
+                doff, dlen = seg.add(np.asarray(c.data).tobytes()
+                                     if c.data is not None else b"")
+                ooff, olen = seg.add(np.asarray(c.offsets, np.int32).tobytes())
+                descs.append(P.COLDESC.pack(
+                    int(c.dtype.id), c.dtype.scale, c.size, hasv,
+                    doff, dlen, voff, vlen) + P.STRDESC.pack(ooff, olen))
+            else:
+                doff, dlen = seg.add(np.asarray(c.data).tobytes())
+                descs.append(P.COLDESC.pack(
+                    int(c.dtype.id), c.dtype.scale, c.size, hasv,
+                    doff, dlen, voff, vlen))
+        m = seg.finish()
+        try:
+            nameb = name.encode()
+            payload = (struct.pack("<I", len(nameb)) + nameb +
+                       struct.pack("<I", table.num_columns) + b"".join(descs))
+            (h,) = struct.unpack("<Q", self._call(P.OP_IMPORT_TABLE, payload))
+        finally:
+            m.close()
+            shmlib.unlink(name)
+        return h
+
+    def convert_to_rows(self, table_handle: int) -> list[int]:
+        body = self._call(P.OP_TO_ROWS, struct.pack("<Q", table_handle))
+        (nb,) = struct.unpack_from("<I", body)
+        return list(struct.unpack_from(f"<{nb}Q", body, 4))
+
+    def convert_from_rows(self, col_handle: int,
+                          schema: list[DType]) -> int:
+        payload = struct.pack("<QI", col_handle, len(schema)) + b"".join(
+            struct.pack("<ii", int(dt.id), dt.scale) for dt in schema)
+        (h,) = struct.unpack("<Q", self._call(P.OP_FROM_ROWS, payload))
+        return h
+
+    def export_table(self, table_handle: int) -> Table:
+        body = self._call(P.OP_EXPORT_TABLE, struct.pack("<Q", table_handle))
+        (nlen,) = struct.unpack_from("<I", body)
+        name = body[4:4 + nlen].decode()
+        _shm_size, ncols = struct.unpack_from("<QI", body, 4 + nlen)
+        off = 4 + nlen + 12
+        m = shmlib.attach(name)
+        try:
+            cols = []
+            for _ in range(ncols):
+                tid, scale, n, hasv, doff, dlen, voff, vlen = \
+                    P.COLDESC.unpack_from(body, off)
+                off += P.COLDESC.size
+                dtype = DType(TypeId(tid), scale)
+                validity = None
+                if hasv:
+                    validity = np.frombuffer(m, np.uint8, vlen, voff) \
+                        .astype(np.bool_)
+                if dtype.is_string:
+                    ooff, olen = P.STRDESC.unpack_from(body, off)
+                    off += P.STRDESC.size
+                    chars = np.frombuffer(m, np.uint8, dlen, doff).copy()
+                    offs = np.frombuffer(m, np.int32, olen // 4, ooff).copy()
+                    cols.append(Column.string(chars, offs, validity))
+                else:
+                    host = np.frombuffer(m, dtype.storage, n, doff).copy()
+                    cols.append(Column.fixed(dtype, host, validity))
+        finally:
+            m.close()
+            self.free_shm(name)
+        return Table(cols)
+
+    def export_rows_column(self, col_handle: int):
+        """Fetch a LIST<INT8> blob column -> (int32 offsets, u8 bytes)."""
+        body = self._call(P.OP_EXPORT_COLUMN, struct.pack("<Q", col_handle))
+        (nlen,) = struct.unpack_from("<I", body)
+        name = body[4:4 + nlen].decode()
+        _size, _n, ooff, olen, doff, dlen = struct.unpack_from(
+            "<QqQQQQ", body, 4 + nlen)
+        m = shmlib.attach(name)
+        try:
+            offs = np.frombuffer(m, np.int32, olen // 4, ooff).copy()
+            data = np.frombuffer(m, np.uint8, dlen, doff).copy()
+        finally:
+            m.close()
+            self.free_shm(name)
+        return offs, data
+
+    def table_meta(self, table_handle: int):
+        body = self._call(P.OP_TABLE_META, struct.pack("<Q", table_handle))
+        ncols, nrows = struct.unpack_from("<Iq", body)
+        schema = []
+        off = 12
+        for _ in range(ncols):
+            tid, scale = struct.unpack_from("<ii", body, off)
+            off += 8
+            schema.append(DType(TypeId(tid), scale))
+        return nrows, schema
+
+    def release(self, handle: int) -> None:
+        self._call(P.OP_RELEASE, struct.pack("<Q", handle))
+
+    def live_count(self) -> int:
+        (n,) = struct.unpack("<I", self._call(P.OP_LIVE_COUNT))
+        return n
+
+    def free_shm(self, name: str) -> None:
+        nameb = name.encode()
+        self._call(P.OP_FREE_SHM, struct.pack("<I", len(nameb)) + nameb)

@@ -1,0 +1,80 @@
+"""Wire protocol for the device-server bridge.
+
+Framing (all little-endian):
+
+    request:  [u32 body_len][u8 opcode][payload ...]
+    response: [u32 body_len][u8 status][payload ...]   status 0=ok, 1=error
+
+On error the payload is a UTF-8 message — the analog of the reference's
+``CATCH_STD`` exception translation at every JNI entry
+(reference RowConversionJni.cpp:40,65).
+
+Bulk column buffers never ride the socket: they sit in POSIX shared memory
+segments in Arrow layout (raw storage-dtype data buffer + byte-per-row u8
+validity), referenced by (offset, length) descriptors.  Shm names travel
+WITHOUT the leading slash (Python's SharedMemory adds it; the C side
+prepends ``/`` for shm_open).
+
+Column descriptor (fixed-width types), repeated per column:
+
+    [i32 type_id][i32 scale][i64 nrows][u8 has_validity]
+    [u64 data_off][u64 data_len][u64 valid_off][u64 valid_len]
+
+STRING columns add Arrow offsets, flagged by type_id == STRING:
+
+    [i32 type_id=23][i32 0][i64 nrows][u8 has_validity]
+    [u64 chars_off][u64 chars_len][u64 valid_off][u64 valid_len]
+    [u64 offsets_off][u64 offsets_len]                  (int32[nrows+1])
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+# opcodes (keep in sync with src/main/cpp/src/tpubridge.cpp)
+OP_PING = 1
+OP_IMPORT_TABLE = 2
+OP_TO_ROWS = 3
+OP_FROM_ROWS = 4
+OP_EXPORT_TABLE = 5
+OP_EXPORT_COLUMN = 6
+OP_RELEASE = 7
+OP_LIVE_COUNT = 8
+OP_SHUTDOWN = 9
+OP_FREE_SHM = 10
+OP_TABLE_META = 11
+
+STATUS_OK = 0
+STATUS_ERROR = 1
+
+_U32 = struct.Struct("<I")
+_HDR = struct.Struct("<IB")  # len + opcode/status
+
+COLDESC = struct.Struct("<iiqBQQQQ")      # typeid, scale, n, hasvalid, 4 bufs
+STRDESC = struct.Struct("<QQ")            # offsets buffer (off, len)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("bridge peer closed the socket")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, first_byte: int, payload: bytes = b"") -> None:
+    sock.sendall(_HDR.pack(1 + len(payload), first_byte) + payload)
+
+
+def recv_msg(sock: socket.socket) -> tuple[int, bytes]:
+    """Returns (opcode_or_status, payload)."""
+    (body_len,) = _U32.unpack(recv_exact(sock, 4))
+    if body_len < 1:
+        # a zero-length frame can't carry an opcode; treat the peer as broken
+        # rather than letting an IndexError escape the dispatch loop
+        raise ConnectionError("malformed bridge frame (empty body)")
+    body = recv_exact(sock, body_len)
+    return body[0], body[1:]

@@ -1,0 +1,304 @@
+"""The device server: handle-table owner and op dispatcher.
+
+TPU-native analog of the reference's native side of the JNI boundary: where
+``RowConversionJni.cpp`` unwraps a jlong into a ``cudf::table_view*`` in the
+same address space (reference RowConversionJni.cpp:31), this server owns a
+``HandleTable`` mapping opaque u64 ids to device-resident ``Table`` /
+``Column`` objects (jax.Arrays in HBM) and executes ops named by opcode.
+Per-op traffic is handles only; bulk host columns stage through shared
+memory at import/export (bridge/__init__ docstring).
+
+Error discipline mirrors ``CATCH_STD`` + ``JNI_NULL_CHECK``
+(reference RowConversionJni.cpp:27,40,65): every dispatch wraps in
+try/except and returns STATUS_ERROR with the message; unknown handles raise
+KeyError -> error response, never a crash.
+
+Run: ``python -m spark_rapids_jni_tpu.bridge.server --socket /tmp/tpub.sock``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import struct
+
+import numpy as np
+
+from . import protocol as P
+from . import shm as shmlib
+from ..columnar import Column, Table
+from ..dtypes import DType, TypeId
+
+_COLDESC = P.COLDESC
+_STRDESC = P.STRDESC
+
+
+class HandleTable:
+    """u64 id -> device object; the process-local analog of JNI jlong handles."""
+
+    def __init__(self):
+        self._next = 1
+        self._objs: dict[int, object] = {}
+
+    def put(self, obj) -> int:
+        h = self._next
+        self._next += 1
+        self._objs[h] = obj
+        return h
+
+    def get(self, h: int):
+        try:
+            return self._objs[h]
+        except KeyError:
+            raise KeyError(f"invalid or released handle {h}") from None
+
+    def release(self, h: int) -> None:
+        if self._objs.pop(h, None) is None:
+            raise KeyError(f"invalid or released handle {h}")
+
+    def live_count(self) -> int:
+        return len(self._objs)
+
+
+def _parse_columns(payload: bytes, off: int, ncols: int, buf) -> list[Column]:
+    """Build device columns from shm-resident Arrow-layout buffers."""
+    import jax.numpy as jnp
+    cols = []
+    for _ in range(ncols):
+        tid, scale, n, hasv, doff, dlen, voff, vlen = _COLDESC.unpack_from(
+            payload, off)
+        off += _COLDESC.size
+        dtype = DType(TypeId(tid), scale)
+        # .copy() everywhere: frombuffer views pin the mmap and would make
+        # the caller's buf.close() raise BufferError
+        validity = None
+        if hasv:
+            vraw = np.frombuffer(buf, np.uint8, vlen, voff).copy()
+            validity = jnp.asarray(vraw.astype(np.bool_))
+        if dtype.is_string:
+            ooff, olen = _STRDESC.unpack_from(payload, off)
+            off += _STRDESC.size
+            chars = np.frombuffer(buf, np.uint8, dlen, doff).copy()
+            offsets = np.frombuffer(buf, np.int32, olen // 4, ooff).copy()
+            cols.append(Column.string(chars, offsets, validity))
+        else:
+            host = np.frombuffer(buf, dtype.storage, n, doff).copy()
+            cols.append(Column.fixed(dtype, host, validity))
+    return cols, off
+
+
+def _export_column_desc(exp: shmlib.SegmentWriter, col: Column) -> bytes:
+    """Write one column's buffers into the exporter, return its descriptor."""
+    n = col.size
+    hasv = col.validity is not None
+    voff = vlen = 0
+    if hasv:
+        voff, vlen = exp.add(np.asarray(col.validity).astype(np.uint8).tobytes())
+    if col.dtype.is_string:
+        chars = b"" if col.data is None else np.asarray(col.data).tobytes()
+        doff, dlen = exp.add(chars)
+        ooff, olen = exp.add(np.asarray(col.offsets, np.int32).tobytes())
+        return _COLDESC.pack(int(col.dtype.id), col.dtype.scale, n, hasv,
+                             doff, dlen, voff, vlen) + _STRDESC.pack(ooff, olen)
+    # fixed-width: device buffer bytes ARE the wire bytes (FLOAT64 stores
+    # IEEE bit patterns as int64 — identical bytes to the doubles)
+    doff, dlen = exp.add(np.asarray(col.data).tobytes())
+    return _COLDESC.pack(int(col.dtype.id), col.dtype.scale, n, hasv,
+                         doff, dlen, voff, vlen)
+
+
+class BridgeServer:
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self.handles = HandleTable()
+        self._exports: dict[str, object] = {}  # shm name -> mmap
+        self._exp_counter = 0
+
+    # -- op implementations ------------------------------------------------
+    def _op_import_table(self, payload: bytes) -> bytes:
+        (nlen,) = struct.unpack_from("<I", payload, 0)
+        name = payload[4:4 + nlen].decode()
+        (ncols,) = struct.unpack_from("<I", payload, 4 + nlen)
+        buf = shmlib.attach(name)
+        try:
+            cols, _ = _parse_columns(payload, 8 + nlen, ncols, buf)
+        finally:
+            buf.close()
+        h = self.handles.put(Table(cols))
+        return struct.pack("<Q", h)
+
+    def _op_to_rows(self, payload: bytes) -> bytes:
+        (h,) = struct.unpack_from("<Q", payload)
+        table = self.handles.get(h)
+        if not isinstance(table, Table):
+            raise TypeError(f"handle {h} is not a table")
+        from ..ops.row_conversion import convert_to_rows
+        blobs = convert_to_rows(table)
+        out = [self.handles.put(b) for b in blobs]
+        return struct.pack("<I", len(out)) + b"".join(
+            struct.pack("<Q", x) for x in out)
+
+    def _op_from_rows(self, payload: bytes) -> bytes:
+        h, ncols = struct.unpack_from("<QI", payload)
+        col = self.handles.get(h)
+        if not isinstance(col, Column):
+            raise TypeError(f"handle {h} is not a column")
+        schema = []
+        off = 12
+        for _ in range(ncols):
+            tid, scale = struct.unpack_from("<ii", payload, off)
+            off += 8
+            schema.append(DType(TypeId(tid), scale))
+        from ..ops.row_conversion import convert_from_rows
+        table = convert_from_rows(col, schema)
+        return struct.pack("<Q", self.handles.put(table))
+
+    def _new_export_name(self) -> str:
+        self._exp_counter += 1
+        return f"tpub-exp-{os.getpid()}-{self._exp_counter}"
+
+    def _op_export_table(self, payload: bytes) -> bytes:
+        (h,) = struct.unpack_from("<Q", payload)
+        table = self.handles.get(h)
+        if not isinstance(table, Table):
+            raise TypeError(f"handle {h} is not a table")
+        name = self._new_export_name()
+        exp = shmlib.SegmentWriter(name)
+        descs = [_export_column_desc(exp, c) for c in table.columns]
+        self._exports[name] = exp.finish()
+        nameb = name.encode()
+        return (struct.pack("<I", len(nameb)) + nameb +
+                struct.pack("<QI", exp.size, table.num_columns) +
+                b"".join(descs))
+
+    def _op_export_column(self, payload: bytes) -> bytes:
+        """Export one LIST<INT8> row-blob column (offsets + child bytes)."""
+        (h,) = struct.unpack_from("<Q", payload)
+        col = self.handles.get(h)
+        if not isinstance(col, Column) or col.dtype.id != TypeId.LIST:
+            raise TypeError(f"handle {h} is not a LIST column")
+        name = self._new_export_name()
+        exp = shmlib.SegmentWriter(name)
+        ooff, olen = exp.add(np.asarray(col.offsets, np.int32).tobytes())
+        child = col.children[0]
+        doff, dlen = exp.add(np.asarray(child.data).tobytes())
+        self._exports[name] = exp.finish()
+        nameb = name.encode()
+        return (struct.pack("<I", len(nameb)) + nameb +
+                struct.pack("<QqQQQQ", exp.size, col.size,
+                            ooff, olen, doff, dlen))
+
+    def _op_free_shm(self, payload: bytes) -> bytes:
+        (nlen,) = struct.unpack_from("<I", payload, 0)
+        name = payload[4:4 + nlen].decode()
+        m = self._exports.pop(name, None)
+        if m is not None:
+            m.close()
+        shmlib.unlink(name)
+        return b""
+
+    def _op_table_meta(self, payload: bytes) -> bytes:
+        (h,) = struct.unpack_from("<Q", payload)
+        table = self.handles.get(h)
+        if not isinstance(table, Table):
+            raise TypeError(f"handle {h} is not a table")
+        out = struct.pack("<Iq", table.num_columns, table.num_rows)
+        for c in table.columns:
+            out += struct.pack("<ii", int(c.dtype.id), c.dtype.scale)
+        return out
+
+    # -- dispatch loop -----------------------------------------------------
+    def _dispatch(self, opcode: int, payload: bytes) -> bytes:
+        if opcode == P.OP_PING:
+            return b"pong"
+        if opcode == P.OP_IMPORT_TABLE:
+            return self._op_import_table(payload)
+        if opcode == P.OP_TO_ROWS:
+            return self._op_to_rows(payload)
+        if opcode == P.OP_FROM_ROWS:
+            return self._op_from_rows(payload)
+        if opcode == P.OP_EXPORT_TABLE:
+            return self._op_export_table(payload)
+        if opcode == P.OP_EXPORT_COLUMN:
+            return self._op_export_column(payload)
+        if opcode == P.OP_RELEASE:
+            (h,) = struct.unpack_from("<Q", payload)
+            self.handles.release(h)
+            return b""
+        if opcode == P.OP_LIVE_COUNT:
+            return struct.pack("<I", self.handles.live_count())
+        if opcode == P.OP_FREE_SHM:
+            return self._op_free_shm(payload)
+        if opcode == P.OP_TABLE_META:
+            return self._op_table_meta(payload)
+        raise ValueError(f"unknown opcode {opcode}")
+
+    def serve_forever(self) -> None:
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.sock_path)
+        srv.listen(4)
+        try:
+            run = True
+            while run:
+                conn, _ = srv.accept()
+                with conn:
+                    run = self._serve_client(conn)
+        finally:
+            srv.close()
+            try:
+                os.unlink(self.sock_path)
+            except FileNotFoundError:
+                pass
+            for name, m in self._exports.items():
+                m.close()
+                shmlib.unlink(name)
+
+    def _serve_client(self, conn: socket.socket) -> bool:
+        """Returns False when a SHUTDOWN was processed."""
+        while True:
+            try:
+                opcode, payload = P.recv_msg(conn)
+            except ConnectionError:
+                return True  # client went away; await the next one
+            if opcode == P.OP_SHUTDOWN:
+                P.send_msg(conn, P.STATUS_OK)
+                return False
+            try:
+                out = self._dispatch(opcode, payload)
+            except Exception as e:  # noqa: BLE001 — CATCH_STD analog
+                status, resp = P.STATUS_ERROR, f"{type(e).__name__}: {e}".encode()
+            else:
+                status, resp = P.STATUS_OK, out
+            try:
+                P.send_msg(conn, status, resp)
+            except (BrokenPipeError, ConnectionError):
+                return True  # client died mid-reply; keep serving others
+
+
+def serve(sock_path: str) -> None:
+    BridgeServer(sock_path).serve_forever()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="TPU bridge device server")
+    ap.add_argument("--socket", required=True)
+    args = ap.parse_args()
+    # Honor an explicit JAX_PLATFORMS before the first jax touch: site hooks
+    # (e.g. a TPU-tunnel registration on PYTHONPATH) may force their own
+    # platform list, and a second process grabbing the one-tenant TPU tunnel
+    # blocks forever.  Tests run the server on CPU for exactly this reason.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+        print(f"[bridge-server] jax platform(s): {plat}", flush=True)
+    serve(args.socket)
+
+
+if __name__ == "__main__":
+    main()

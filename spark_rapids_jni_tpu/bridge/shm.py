@@ -1,0 +1,75 @@
+"""POSIX shared-memory segments as /dev/shm files.
+
+On Linux ``shm_open(name)`` IS ``open("/dev/shm" + name)`` — using the file
+API directly keeps Python 3.12's multiprocessing resource tracker out of the
+picture (it would warn-and-unlink segments the C side still owns) and gives
+the C client and this server the same view byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+
+SHM_DIR = "/dev/shm"
+
+
+def shm_path(name: str) -> str:
+    if "/" in name or name.startswith("."):
+        raise ValueError(f"bad shm name {name!r}")
+    return os.path.join(SHM_DIR, name)
+
+
+def create(name: str, size: int) -> mmap.mmap:
+    fd = os.open(shm_path(name), os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    try:
+        os.ftruncate(fd, size)
+        return mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+
+
+def attach(name: str) -> mmap.mmap:
+    fd = os.open(shm_path(name), os.O_RDWR)
+    try:
+        size = os.fstat(fd).st_size
+        return mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+
+
+def unlink(name: str) -> None:
+    try:
+        os.unlink(shm_path(name))
+    except FileNotFoundError:
+        pass
+
+
+def align8(x: int) -> int:
+    return (x + 7) & ~7
+
+
+class SegmentWriter:
+    """Accumulates 8-byte-aligned buffers, then writes one shm segment.
+
+    The single definition of the segment layout both bridge sides use (the
+    client for imports, the server for exports) — keep it in lockstep with
+    the (offset, length) descriptors in protocol.py.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.chunks: list[tuple[int, bytes]] = []
+        self.size = 0
+
+    def add(self, raw: bytes) -> tuple[int, int]:
+        off = align8(self.size)
+        self.chunks.append((off, raw))
+        self.size = off + len(raw)
+        return off, len(raw)
+
+    def finish(self) -> mmap.mmap:
+        m = create(self.name, max(self.size, 1))
+        for off, raw in self.chunks:
+            m[off:off + len(raw)] = raw
+        return m

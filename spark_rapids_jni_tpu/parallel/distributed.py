@@ -71,21 +71,24 @@ def _padded_table(out_keys, out_aggs, key_names):
 def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
                               key_names: tuple, aggs: tuple,
                               capacity: int, axis: str = ROW_AXIS,
-                              n_valid: int | None = None):
+                              masked: bool = False):
     """Compile-once distributed GROUP BY for a fixed schema.
 
-    Returns fn(datas, masks) -> (key+agg padded buffers, live mask, ngroups
-    per shard, overflow) operating on row-sharded column buffers.
+    Returns fn(datas, masks[, n_valid]) -> (key+agg padded buffers, live
+    mask, ngroups per shard, overflow) operating on row-sharded column
+    buffers.
 
-    ``n_valid``: original (pre-padding) global row count.  Rows at global
-    index >= n_valid are pad_to_multiple null rows and are masked out of the
-    local partial pass — without this they would form a spurious null-key
-    group and corrupt genuine null-key aggregates.
+    With ``masked=True`` the function takes a traced scalar ``n_valid`` (the
+    original, pre-padding global row count) so ONE compiled program serves
+    any row count at a fixed padded shape.  Rows at global index >= n_valid
+    are pad_to_multiple null rows and are masked out of the local partial
+    pass — without this they would form a spurious null-key group and
+    corrupt genuine null-key aggregates.
     """
     ndev = mesh.shape[axis]
     partial_specs, final_plan = _expand_aggs(aggs)
 
-    def shard_fn(datas, masks):
+    def shard_fn(datas, masks, n_valid=None):
         shard_tbl = Table([Column(dt, data=d, validity=m)
                            for dt, d, m in zip(schema, datas, masks)],
                           list(names))
@@ -163,8 +166,14 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
                 jnp.reshape(ng, (1,)), jax.lax.psum(overflow, axis))
 
     spec = P(axis)
+    if masked:
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec, spec, P()),
+            out_specs=(spec, spec, spec, spec, spec, spec, P()),
+            check_vma=False)
     return shard_map(
-        shard_fn, mesh=mesh, in_specs=(spec, spec),
+        lambda datas, masks: shard_fn(datas, masks), mesh=mesh,
+        in_specs=(spec, spec),
         out_specs=(spec, spec, spec, spec, spec, spec, P()),
         check_vma=False)
 
@@ -208,11 +217,15 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
         mesh, tuple(table.dtypes()),
         tuple(table.names or [f"c{i}" for i in range(table.num_columns)]),
         tuple(key_names), tuple(aggs), capacity, axis,
-        n_valid=n_valid_rows)
+        masked=n_valid_rows is not None)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
-    (key_data, key_valid, agg_data, agg_valid, live, _ng,
-     overflow) = jax.jit(fn)(datas, masks)
+    if n_valid_rows is not None:
+        (key_data, key_valid, agg_data, agg_valid, live, _ng,
+         overflow) = jax.jit(fn)(datas, masks, jnp.int64(n_valid_rows))
+    else:
+        (key_data, key_valid, agg_data, agg_valid, live, _ng,
+         overflow) = jax.jit(fn)(datas, masks)
     if int(overflow) > 0:
         raise RuntimeError(
             f"shuffle capacity overflow ({int(overflow)} rows); rerun with "

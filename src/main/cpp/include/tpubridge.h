@@ -1,0 +1,101 @@
+/* tpubridge: C ABI for the TPU device-server bridge.
+ *
+ * The native half of the FFI discipline the reference establishes with JNI
+ * (reference src/main/cpp/src/RowConversionJni.cpp:24-66): callers hold
+ * opaque 64-bit handles to device-resident tables/columns; per-op traffic is
+ * handles only.  Bulk host columns cross once, at import/export, through
+ * POSIX shared memory in Arrow layout (data buffer + byte-per-row validity).
+ *
+ * A JVM binds this through the thin JNI adapter (tpubridge_jni.cpp, compiled
+ * only when a JDK is present); any other host language binds the C ABI
+ * directly (the test harness uses it from C++ and Python ctypes).
+ *
+ * All functions return 0 on success, negative on failure;
+ * tpub_last_error(ctx) returns the last error message (CATCH_STD analog).
+ */
+#ifndef TPUBRIDGE_H
+#define TPUBRIDGE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpub_ctx tpub_ctx;
+
+/* Column descriptor for import/export. Buffers are raw Arrow layout:
+ * data = storage-dtype values (FLOAT64 = IEEE doubles, BOOL8 = one byte/row),
+ * validity = one byte per row (0 null, 1 valid), NULL if none.
+ * For STRING columns data is the UTF-8 char buffer and offsets is
+ * int32[nrows+1]; offsets is NULL for fixed-width columns. */
+typedef struct {
+  int32_t type_id;   /* cudf-compatible type id (dtypes.py TypeId) */
+  int32_t scale;     /* decimal scale, else 0 */
+  int64_t nrows;
+  const void *data;
+  int64_t data_len;        /* bytes */
+  const uint8_t *validity; /* may be NULL */
+  const int32_t *offsets;  /* STRING only, else NULL */
+} tpub_col;
+
+/* connection ------------------------------------------------------------- */
+tpub_ctx *tpub_connect(const char *socket_path);
+void tpub_disconnect(tpub_ctx *ctx);
+const char *tpub_last_error(tpub_ctx *ctx);
+int tpub_ping(tpub_ctx *ctx);
+int tpub_shutdown_server(tpub_ctx *ctx);
+
+/* handle ops ------------------------------------------------------------- */
+/* Stage a host table to the device; returns handle via *out. */
+int tpub_import_table(tpub_ctx *ctx, const tpub_col *cols, int32_t ncols,
+                      uint64_t *out);
+
+/* RowConversion.convertToRows: table handle -> up to *count blob-column
+ * handles written to out[]; *count holds capacity in, result count out. */
+int tpub_convert_to_rows(tpub_ctx *ctx, uint64_t table, uint64_t *out,
+                         int32_t *count);
+
+/* RowConversion.convertFromRows: LIST<INT8> column handle + flattened
+ * (type_id, scale) schema -> table handle. */
+int tpub_convert_from_rows(tpub_ctx *ctx, uint64_t column,
+                           const int32_t *type_ids, const int32_t *scales,
+                           int32_t ncols, uint64_t *out);
+
+/* export ------------------------------------------------------------------ */
+/* Fetch table metadata: *ncols and *nrows. */
+int tpub_table_meta(tpub_ctx *ctx, uint64_t table, int32_t *ncols,
+                    int64_t *nrows);
+
+/* Fetch a whole table back to host memory.  The library allocates one block
+ * holding all buffers; cols[i] descriptors point into it.  Free with
+ * tpub_free_export. */
+typedef struct {
+  tpub_col *cols;
+  int32_t ncols;
+  void *block; /* owned */
+} tpub_export;
+int tpub_export_table(tpub_ctx *ctx, uint64_t table, tpub_export *out);
+void tpub_free_export(tpub_export *e);
+
+/* Fetch a LIST<INT8> row-blob column: int32 offsets[nrows+1] + bytes.
+ * Both buffers live in one owned block; free with tpub_free_rows. */
+typedef struct {
+  int64_t nrows;
+  const int32_t *offsets;
+  const uint8_t *data;
+  int64_t data_len;
+  void *block; /* owned */
+} tpub_rows;
+int tpub_export_rows(tpub_ctx *ctx, uint64_t column, tpub_rows *out);
+void tpub_free_rows(tpub_rows *r);
+
+/* lifecycle --------------------------------------------------------------- */
+int tpub_release(tpub_ctx *ctx, uint64_t handle);
+int tpub_live_count(tpub_ctx *ctx, int32_t *out);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPUBRIDGE_H */

@@ -1,0 +1,433 @@
+/* tpubridge: native client for the TPU device-server bridge.
+ *
+ * Speaks the length-prefixed command protocol of
+ * spark_rapids_jni_tpu/bridge/protocol.py over a Unix domain socket and
+ * stages bulk column buffers through POSIX shared memory.  This is the
+ * process-separated analog of the reference's JNI shim layer
+ * (reference src/main/cpp/src/RowConversionJni.cpp): where that code
+ * reinterpret_casts jlong handles inside one address space, this one ships
+ * the same 64-bit handles across a socket to the device-server process that
+ * owns the HBM-resident tables.
+ */
+#include "tpubridge.h"
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+/* opcodes — keep in sync with bridge/protocol.py */
+enum Op : uint8_t {
+  OP_PING = 1,
+  OP_IMPORT_TABLE = 2,
+  OP_TO_ROWS = 3,
+  OP_FROM_ROWS = 4,
+  OP_EXPORT_TABLE = 5,
+  OP_EXPORT_COLUMN = 6,
+  OP_RELEASE = 7,
+  OP_LIVE_COUNT = 8,
+  OP_SHUTDOWN = 9,
+  OP_FREE_SHM = 10,
+  OP_TABLE_META = 11,
+};
+
+constexpr uint8_t STATUS_OK = 0;
+
+/* little-endian append helpers (x86/arm hosts are LE; wire is LE) */
+template <typename T>
+void put(std::vector<uint8_t> &buf, T v) {
+  const auto *p = reinterpret_cast<const uint8_t *>(&v);
+  buf.insert(buf.end(), p, p + sizeof(T));
+}
+
+template <typename T>
+T get(const uint8_t *p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;
+}
+
+int64_t align8(int64_t x) { return (x + 7) & ~int64_t(7); }
+
+struct Shm {
+  std::string name; /* without leading slash, as on the wire */
+  int fd = -1;
+  uint8_t *map = nullptr;
+  size_t size = 0;
+  bool owner = false;
+
+  int create(const std::string &nm, size_t sz) {
+    name = nm;
+    owner = true;
+    std::string path = "/" + nm;
+    fd = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return -1;
+    if (ftruncate(fd, (off_t)sz) != 0) return -1;
+    map = (uint8_t *)mmap(nullptr, sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (map == MAP_FAILED) { map = nullptr; return -1; }
+    size = sz;
+    return 0;
+  }
+
+  int attach(const std::string &nm) {
+    name = nm;
+    std::string path = "/" + nm;
+    fd = shm_open(path.c_str(), O_RDWR, 0600);
+    if (fd < 0) return -1;
+    struct stat st;
+    if (fstat(fd, &st) != 0) return -1;
+    size = (size_t)st.st_size;
+    map = (uint8_t *)mmap(nullptr, size, PROT_READ, MAP_SHARED, fd, 0);
+    if (map == MAP_FAILED) { map = nullptr; return -1; }
+    return 0;
+  }
+
+  ~Shm() {
+    if (map) munmap(map, size);
+    if (fd >= 0) close(fd);
+    if (owner) shm_unlink(("/" + name).c_str());
+  }
+};
+
+} // namespace
+
+struct tpub_ctx {
+  int sock = -1;
+  std::string last_error;
+  uint64_t imp_counter = 0;
+
+  int fail(const std::string &msg) {
+    last_error = msg;
+    return -1;
+  }
+
+  int send_all(const void *buf, size_t n) {
+    const auto *p = (const uint8_t *)buf;
+    while (n) {
+      ssize_t w = ::send(sock, p, n, MSG_NOSIGNAL);
+      if (w <= 0) {
+        if (w < 0 && errno == EINTR) continue;
+        return fail("bridge socket send failed");
+      }
+      p += w;
+      n -= (size_t)w;
+    }
+    return 0;
+  }
+
+  int recv_all(void *buf, size_t n) {
+    auto *p = (uint8_t *)buf;
+    while (n) {
+      ssize_t r = ::recv(sock, p, n, 0);
+      if (r <= 0) {
+        if (r < 0 && errno == EINTR) continue;
+        return fail("bridge socket recv failed / peer closed");
+      }
+      p += r;
+      n -= (size_t)r;
+    }
+    return 0;
+  }
+
+  /* one request/response round trip; resp gets the payload after status */
+  int call(uint8_t opcode, const std::vector<uint8_t> &payload,
+           std::vector<uint8_t> &resp) {
+    uint32_t body_len = 1 + (uint32_t)payload.size();
+    std::vector<uint8_t> hdr;
+    put<uint32_t>(hdr, body_len);
+    hdr.push_back(opcode);
+    if (send_all(hdr.data(), hdr.size()) != 0) return -1;
+    if (!payload.empty() && send_all(payload.data(), payload.size()) != 0)
+      return -1;
+
+    uint32_t rlen;
+    if (recv_all(&rlen, 4) != 0) return -1;
+    if (rlen < 1) return fail("malformed bridge response");
+    std::vector<uint8_t> body(rlen);
+    if (recv_all(body.data(), rlen) != 0) return -1;
+    if (body[0] != STATUS_OK) {
+      last_error.assign((const char *)body.data() + 1, body.size() - 1);
+      return -1;
+    }
+    resp.assign(body.begin() + 1, body.end());
+    return 0;
+  }
+};
+
+extern "C" {
+
+tpub_ctx *tpub_connect(const char *socket_path) {
+  auto *ctx = new tpub_ctx();
+  ctx->sock = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (ctx->sock < 0) { delete ctx; return nullptr; }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof addr.sun_path, "%s", socket_path);
+  if (connect(ctx->sock, (sockaddr *)&addr, sizeof addr) != 0) {
+    close(ctx->sock);
+    delete ctx;
+    return nullptr;
+  }
+  return ctx;
+}
+
+void tpub_disconnect(tpub_ctx *ctx) {
+  if (!ctx) return;
+  if (ctx->sock >= 0) close(ctx->sock);
+  delete ctx;
+}
+
+const char *tpub_last_error(tpub_ctx *ctx) {
+  return ctx ? ctx->last_error.c_str() : "null context";
+}
+
+int tpub_ping(tpub_ctx *ctx) {
+  std::vector<uint8_t> resp;
+  return ctx->call(OP_PING, {}, resp);
+}
+
+int tpub_shutdown_server(tpub_ctx *ctx) {
+  std::vector<uint8_t> resp;
+  return ctx->call(OP_SHUTDOWN, {}, resp);
+}
+
+int tpub_import_table(tpub_ctx *ctx, const tpub_col *cols, int32_t ncols,
+                      uint64_t *out) {
+  /* lay out every buffer in one shm segment, 8-byte aligned */
+  int64_t size = 0;
+  struct Placed { int64_t doff, dlen, voff, vlen, ooff, olen; };
+  std::vector<Placed> placed((size_t)ncols);
+  for (int32_t i = 0; i < ncols; ++i) {
+    const tpub_col &c = cols[i];
+    Placed &p = placed[i];
+    if (c.validity) {
+      p.voff = align8(size);
+      p.vlen = c.nrows;
+      size = p.voff + p.vlen;
+    }
+    p.doff = align8(size);
+    p.dlen = c.data_len;
+    size = p.doff + p.dlen;
+    if (c.offsets) { /* STRING */
+      p.ooff = align8(size);
+      p.olen = (c.nrows + 1) * 4;
+      size = p.ooff + p.olen;
+    }
+  }
+  char namebuf[64];
+  std::snprintf(namebuf, sizeof namebuf, "tpub-imp-%d-%llu", (int)getpid(),
+                (unsigned long long)++ctx->imp_counter);
+  Shm shm;
+  if (shm.create(namebuf, (size_t)(size > 0 ? size : 1)) != 0)
+    return ctx->fail(std::string("shm create failed: ") + strerror(errno));
+  for (int32_t i = 0; i < ncols; ++i) {
+    const tpub_col &c = cols[i];
+    const Placed &p = placed[i];
+    if (c.validity) std::memcpy(shm.map + p.voff, c.validity, (size_t)p.vlen);
+    if (c.data_len) std::memcpy(shm.map + p.doff, c.data, (size_t)p.dlen);
+    if (c.offsets) std::memcpy(shm.map + p.ooff, c.offsets, (size_t)p.olen);
+  }
+
+  std::vector<uint8_t> payload;
+  uint32_t nlen = (uint32_t)std::strlen(namebuf);
+  put<uint32_t>(payload, nlen);
+  payload.insert(payload.end(), (uint8_t *)namebuf, (uint8_t *)namebuf + nlen);
+  put<uint32_t>(payload, (uint32_t)ncols);
+  for (int32_t i = 0; i < ncols; ++i) {
+    const tpub_col &c = cols[i];
+    const Placed &p = placed[i];
+    put<int32_t>(payload, c.type_id);
+    put<int32_t>(payload, c.scale);
+    put<int64_t>(payload, c.nrows);
+    payload.push_back(c.validity ? 1 : 0);
+    put<uint64_t>(payload, (uint64_t)p.doff);
+    put<uint64_t>(payload, (uint64_t)p.dlen);
+    put<uint64_t>(payload, (uint64_t)p.voff);
+    put<uint64_t>(payload, (uint64_t)p.vlen);
+    if (c.offsets) {
+      put<uint64_t>(payload, (uint64_t)p.ooff);
+      put<uint64_t>(payload, (uint64_t)p.olen);
+    }
+  }
+  std::vector<uint8_t> resp;
+  int rc = ctx->call(OP_IMPORT_TABLE, payload, resp);
+  /* shm unlinked by Shm dtor — server copied during the call */
+  if (rc != 0) return rc;
+  if (resp.size() != 8) return ctx->fail("bad import response");
+  *out = get<uint64_t>(resp.data());
+  return 0;
+}
+
+int tpub_convert_to_rows(tpub_ctx *ctx, uint64_t table, uint64_t *out,
+                         int32_t *count) {
+  std::vector<uint8_t> payload, resp;
+  put<uint64_t>(payload, table);
+  if (ctx->call(OP_TO_ROWS, payload, resp) != 0) return -1;
+  if (resp.size() < 4) return ctx->fail("bad to_rows response");
+  int32_t nb = (int32_t)get<uint32_t>(resp.data());
+  if (nb > *count) return ctx->fail("to_rows: output array too small");
+  for (int32_t i = 0; i < nb; ++i)
+    out[i] = get<uint64_t>(resp.data() + 4 + 8 * (size_t)i);
+  *count = nb;
+  return 0;
+}
+
+int tpub_convert_from_rows(tpub_ctx *ctx, uint64_t column,
+                           const int32_t *type_ids, const int32_t *scales,
+                           int32_t ncols, uint64_t *out) {
+  std::vector<uint8_t> payload, resp;
+  put<uint64_t>(payload, column);
+  put<uint32_t>(payload, (uint32_t)ncols);
+  for (int32_t i = 0; i < ncols; ++i) {
+    put<int32_t>(payload, type_ids[i]);
+    put<int32_t>(payload, scales[i]);
+  }
+  if (ctx->call(OP_FROM_ROWS, payload, resp) != 0) return -1;
+  if (resp.size() != 8) return ctx->fail("bad from_rows response");
+  *out = get<uint64_t>(resp.data());
+  return 0;
+}
+
+int tpub_table_meta(tpub_ctx *ctx, uint64_t table, int32_t *ncols,
+                    int64_t *nrows) {
+  std::vector<uint8_t> payload, resp;
+  put<uint64_t>(payload, table);
+  if (ctx->call(OP_TABLE_META, payload, resp) != 0) return -1;
+  if (resp.size() < 12) return ctx->fail("bad table_meta response");
+  *ncols = (int32_t)get<uint32_t>(resp.data());
+  *nrows = get<int64_t>(resp.data() + 4);
+  return 0;
+}
+
+static int free_remote_shm(tpub_ctx *ctx, const std::string &name) {
+  std::vector<uint8_t> payload, resp;
+  put<uint32_t>(payload, (uint32_t)name.size());
+  payload.insert(payload.end(), name.begin(), name.end());
+  return ctx->call(OP_FREE_SHM, payload, resp);
+}
+
+int tpub_export_table(tpub_ctx *ctx, uint64_t table, tpub_export *out) {
+  std::vector<uint8_t> payload, resp;
+  put<uint64_t>(payload, table);
+  if (ctx->call(OP_EXPORT_TABLE, payload, resp) != 0) return -1;
+  const uint8_t *p = resp.data();
+  uint32_t nlen = get<uint32_t>(p);
+  std::string name((const char *)p + 4, nlen);
+  p += 4 + nlen;
+  uint64_t shm_size = get<uint64_t>(p);
+  int32_t ncols = (int32_t)get<uint32_t>(p + 8);
+  p += 12;
+
+  Shm shm;
+  if (shm.attach(name) != 0) {
+    free_remote_shm(ctx, name);
+    return ctx->fail("export shm attach failed");
+  }
+  /* single owned block: copy of the whole shm + descriptor array */
+  size_t block_sz = (size_t)shm_size + sizeof(tpub_col) * (size_t)ncols;
+  auto *block = (uint8_t *)std::malloc(block_sz ? block_sz : 1);
+  if (!block) { free_remote_shm(ctx, name); return ctx->fail("oom"); }
+  std::memcpy(block, shm.map, (size_t)shm_size);
+  auto *cols = (tpub_col *)(block + shm_size);
+
+  for (int32_t i = 0; i < ncols; ++i) {
+    tpub_col &c = cols[i];
+    c.type_id = get<int32_t>(p);
+    c.scale = get<int32_t>(p + 4);
+    c.nrows = get<int64_t>(p + 8);
+    uint8_t hasv = p[16];
+    uint64_t doff = get<uint64_t>(p + 17), dlen = get<uint64_t>(p + 25);
+    uint64_t voff = get<uint64_t>(p + 33), vlen = get<uint64_t>(p + 41);
+    p += 49;
+    c.data = block + doff;
+    c.data_len = (int64_t)dlen;
+    c.validity = hasv ? block + voff : nullptr;
+    (void)vlen;
+    if (c.type_id == 23 /* STRING */) {
+      uint64_t ooff = get<uint64_t>(p);
+      p += 16;
+      c.offsets = (const int32_t *)(block + ooff);
+    } else {
+      c.offsets = nullptr;
+    }
+  }
+  free_remote_shm(ctx, name);
+  out->cols = cols;
+  out->ncols = ncols;
+  out->block = block;
+  return 0;
+}
+
+void tpub_free_export(tpub_export *e) {
+  if (e && e->block) {
+    std::free(e->block);
+    e->block = nullptr;
+    e->cols = nullptr;
+  }
+}
+
+int tpub_export_rows(tpub_ctx *ctx, uint64_t column, tpub_rows *out) {
+  std::vector<uint8_t> payload, resp;
+  put<uint64_t>(payload, column);
+  if (ctx->call(OP_EXPORT_COLUMN, payload, resp) != 0) return -1;
+  const uint8_t *p = resp.data();
+  uint32_t nlen = get<uint32_t>(p);
+  std::string name((const char *)p + 4, nlen);
+  p += 4 + nlen;
+  uint64_t shm_size = get<uint64_t>(p);
+  int64_t nrows = get<int64_t>(p + 8);
+  uint64_t ooff = get<uint64_t>(p + 16), olen = get<uint64_t>(p + 24);
+  uint64_t doff = get<uint64_t>(p + 32), dlen = get<uint64_t>(p + 40);
+  (void)olen;
+
+  Shm shm;
+  if (shm.attach(name) != 0) {
+    free_remote_shm(ctx, name);
+    return ctx->fail("rows shm attach failed");
+  }
+  auto *block = (uint8_t *)std::malloc((size_t)shm_size ? (size_t)shm_size : 1);
+  if (!block) { free_remote_shm(ctx, name); return ctx->fail("oom"); }
+  std::memcpy(block, shm.map, (size_t)shm_size);
+  free_remote_shm(ctx, name);
+
+  out->nrows = nrows;
+  out->offsets = (const int32_t *)(block + ooff);
+  out->data = block + doff;
+  out->data_len = (int64_t)dlen;
+  out->block = block;
+  return 0;
+}
+
+void tpub_free_rows(tpub_rows *r) {
+  if (r && r->block) {
+    std::free(r->block);
+    r->block = nullptr;
+  }
+}
+
+int tpub_release(tpub_ctx *ctx, uint64_t handle) {
+  std::vector<uint8_t> payload, resp;
+  put<uint64_t>(payload, handle);
+  return ctx->call(OP_RELEASE, payload, resp);
+}
+
+int tpub_live_count(tpub_ctx *ctx, int32_t *out) {
+  std::vector<uint8_t> resp;
+  if (ctx->call(OP_LIVE_COUNT, {}, resp) != 0) return -1;
+  if (resp.size() != 4) return ctx->fail("bad live_count response");
+  *out = (int32_t)get<uint32_t>(resp.data());
+  return 0;
+}
+
+} /* extern "C" */

@@ -1,0 +1,121 @@
+/* JNI adapter: binds the Java surface in src/main/java to the C ABI.
+ *
+ * The analog of the reference's per-op JNI shims
+ * (reference src/main/cpp/src/RowConversionJni.cpp:24-66): unwrap jlong
+ * handles, call the native layer, wrap results back into jlong arrays, and
+ * translate failures into Java exceptions.  Compiled only when a JDK is
+ * present (see CMakeLists.txt); the C ABI in tpubridge.cpp carries the same
+ * capability for non-JVM hosts and is what CI exercises.
+ *
+ * One process-global connection (TpuBridge.connect) plays the role the
+ * reference gives auto_set_device: binding the calling JVM to its device
+ * server.
+ */
+#include <jni.h>
+
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "../include/tpubridge.h"
+
+namespace {
+tpub_ctx *g_ctx = nullptr;
+std::mutex g_mu;
+
+void throw_runtime(JNIEnv *env, const char *msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls) env->ThrowNew(cls, msg);
+}
+
+tpub_ctx *ctx_or_throw(JNIEnv *env) {
+  if (!g_ctx) throw_runtime(env, "TpuBridge.connect() has not been called");
+  return g_ctx;
+}
+} // namespace
+
+extern "C" {
+
+JNIEXPORT jboolean JNICALL
+Java_com_nvidia_spark_rapids_jni_TpuBridge_connectNative(JNIEnv *env, jclass,
+                                                         jstring jpath) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_ctx) return JNI_TRUE;
+  const char *path = env->GetStringUTFChars(jpath, nullptr);
+  g_ctx = tpub_connect(path);
+  env->ReleaseStringUTFChars(jpath, path);
+  if (!g_ctx) throw_runtime(env, "cannot connect to device server");
+  return g_ctx ? JNI_TRUE : JNI_FALSE;
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_TpuBridge_disconnectNative(JNIEnv *, jclass) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_ctx) {
+    tpub_disconnect(g_ctx);
+    g_ctx = nullptr;
+  }
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(JNIEnv *env,
+                                                             jclass,
+                                                             jlong table) {
+  tpub_ctx *ctx = ctx_or_throw(env);
+  if (!ctx) return nullptr;
+  uint64_t out[64];
+  int32_t count = 64;
+  if (tpub_convert_to_rows(ctx, (uint64_t)table, out, &count) != 0) {
+    throw_runtime(env, tpub_last_error(ctx));
+    return nullptr;
+  }
+  jlongArray arr = env->NewLongArray(count);
+  if (!arr) return nullptr;
+  std::vector<jlong> tmp(out, out + count);
+  env->SetLongArrayRegion(arr, 0, count, tmp.data());
+  return arr;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
+    JNIEnv *env, jclass, jlong column, jintArray jtypes, jintArray jscales) {
+  tpub_ctx *ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  jsize n = env->GetArrayLength(jtypes);
+  std::vector<jint> types(n), scales(n);
+  env->GetIntArrayRegion(jtypes, 0, n, types.data());
+  env->GetIntArrayRegion(jscales, 0, n, scales.data());
+  uint64_t out = 0;
+  if (tpub_convert_from_rows(ctx, (uint64_t)column,
+                             (const int32_t *)types.data(),
+                             (const int32_t *)scales.data(), (int32_t)n,
+                             &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT void JNICALL
+Java_com_nvidia_spark_rapids_jni_TpuBridge_releaseNative(JNIEnv *env, jclass,
+                                                         jlong handle) {
+  tpub_ctx *ctx = ctx_or_throw(env);
+  if (!ctx) return;
+  if (tpub_release(ctx, (uint64_t)handle) != 0)
+    throw_runtime(env, tpub_last_error(ctx));
+}
+
+JNIEXPORT jint JNICALL
+Java_com_nvidia_spark_rapids_jni_TpuBridge_liveCountNative(JNIEnv *env,
+                                                           jclass) {
+  tpub_ctx *ctx = ctx_or_throw(env);
+  if (!ctx) return -1;
+  int32_t n = 0;
+  if (tpub_live_count(ctx, &n) != 0) {
+    throw_runtime(env, tpub_last_error(ctx));
+    return -1;
+  }
+  return (jint)n;
+}
+
+} /* extern "C" */

@@ -1,0 +1,30 @@
+/*
+ * Owner of one device-resident column handle (e.g. a LIST<INT8> row-blob
+ * batch returned by RowConversion.convertToRows) — the AutoCloseable analog
+ * of ai.rapids.cudf.ColumnVector handle ownership
+ * (reference RowConversion.java:103-107 wraps each returned jlong).
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class DeviceColumn implements AutoCloseable {
+  private long handle;
+
+  DeviceColumn(long handle) {
+    this.handle = handle;
+  }
+
+  public long getHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("column already closed");
+    }
+    return handle;
+  }
+
+  @Override
+  public synchronized void close() {
+    if (handle != 0) {
+      TpuBridge.release(handle);
+      handle = 0;
+    }
+  }
+}

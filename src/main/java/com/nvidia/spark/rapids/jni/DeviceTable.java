@@ -1,0 +1,32 @@
+/*
+ * Owner of one device-resident table handle.
+ *
+ * Plays the part ai.rapids.cudf.Table plays for the reference (the jlong
+ * handle target of RowConversionJni.cpp:31): an AutoCloseable whose close()
+ * releases the device object, giving callers the same try-with-resources
+ * discipline the reference test exercises (RowConversionTest.java:53-57).
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class DeviceTable implements AutoCloseable {
+  private long handle;
+
+  DeviceTable(long handle) {
+    this.handle = handle;
+  }
+
+  public long getHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("table already closed");
+    }
+    return handle;
+  }
+
+  @Override
+  public synchronized void close() {
+    if (handle != 0) {
+      TpuBridge.release(handle);
+      handle = 0;
+    }
+  }
+}

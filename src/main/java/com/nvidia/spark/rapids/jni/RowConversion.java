@@ -1,0 +1,43 @@
+/*
+ * RowConversion: columnar device tables <-> packed row-major blobs.
+ *
+ * Same public shape as the reference op class (reference
+ * RowConversion.java:101-121): convertToRows hands back one LIST<INT8>
+ * column per size-bounded batch; convertFromRows rebuilds a table from a
+ * blob column plus the flattened (type-id, scale) schema the caller
+ * recorded.  The row wire format (64-bit aligned packing, validity bytes at
+ * the row tail, 64-bit row padding, batches under 2^31 bytes) is produced
+ * by the device server's XLA kernels and matches the reference's layout
+ * contract so UnsafeRow-style consumers interoperate.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class RowConversion {
+  private RowConversion() {}
+
+  /** Convert a device table to packed rows; one column per batch. */
+  public static DeviceColumn[] convertToRows(DeviceTable table) {
+    long[] handles = convertToRows(table.getHandle());
+    DeviceColumn[] out = new DeviceColumn[handles.length];
+    for (int i = 0; i < handles.length; i++) {
+      out[i] = new DeviceColumn(handles[i]);
+    }
+    return out;
+  }
+
+  /**
+   * Convert packed rows back to a columnar table.
+   *
+   * @param rows    a LIST&lt;INT8&gt; blob column from convertToRows
+   * @param typeIds cudf-compatible type id per output column
+   * @param scales  decimal scale per output column (0 for non-decimals)
+   */
+  public static DeviceTable convertFromRows(DeviceColumn rows, int[] typeIds,
+                                            int[] scales) {
+    return new DeviceTable(convertFromRows(rows.getHandle(), typeIds, scales));
+  }
+
+  private static native long[] convertToRows(long tableHandle);
+  private static native long convertFromRows(long columnHandle, int[] typeIds,
+                                             int[] scales);
+}

@@ -1,0 +1,46 @@
+/*
+ * Java surface of the TPU device-server bridge.
+ *
+ * Process-global connection management plus the handle lifecycle shared by
+ * every op class.  Mirrors the role NativeDepsLoader + auto_set_device play
+ * in the reference stack (reference RowConversion.java:23-25,
+ * RowConversionJni.cpp:30): bind the JVM to its accelerator runtime once,
+ * then pass opaque 64-bit handles on every call.  Bulk data never crosses
+ * this API — a handle names a device-resident table or column owned by the
+ * device-server process.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class TpuBridge {
+  static {
+    // libtpubridge_jni.so (which pulls libtpubridge.so via $ORIGIN rpath)
+    // is expected on java.library.path, unpacked from the jar the same way
+    // the reference's NativeDepsLoader extracts its .so resources.
+    System.loadLibrary("tpubridge_jni");
+  }
+
+  private TpuBridge() {}
+
+  /** Connect this JVM to the device server (idempotent). */
+  public static synchronized void connect(String socketPath) {
+    connectNative(socketPath);
+  }
+
+  public static synchronized void disconnect() {
+    disconnectNative();
+  }
+
+  /** Number of live device handles — the leak-check hook tests assert on. */
+  public static int liveHandleCount() {
+    return liveCountNative();
+  }
+
+  static void release(long handle) {
+    releaseNative(handle);
+  }
+
+  private static native boolean connectNative(String socketPath);
+  private static native void disconnectNative();
+  private static native void releaseNative(long handle);
+  private static native int liveCountNative();
+}

@@ -1,0 +1,159 @@
+"""Bridge layer tests: the FFI discipline of the reference, process-separated.
+
+Covers the VERDICT r1 "done" bar for the bridge: a port of the reference
+round-trip test (RowConversionTest.java:29-59) driven end-to-end through the
+handle API with only handles crossing per-op, plus the close()/leak
+discipline (RowConversionTest.java:53-57) — once through the pure-Python
+client, and once through the real native C ABI (libtpubridge.so +
+bridge_roundtrip_test, the compiled analog of the JNI layer).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_BUILD = os.path.join(REPO, "target", "cmake-build")
+C_HARNESS = os.path.join(NATIVE_BUILD, "bridge_roundtrip_test")
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("bridge") / "tpub.sock")
+    proc = spawn_server(sock)
+    yield sock
+    try:
+        c = BridgeClient(sock)
+        c.shutdown_server()
+    except Exception:
+        proc.kill()
+    proc.wait(timeout=30)
+
+
+def reference_test_table() -> Table:
+    """The 8-column fixture of RowConversionTest.java:30-39: every reference
+    type family, trailing null per column."""
+    valid = np.array([1, 1, 1, 1, 1, 0], np.bool_)
+    return Table([
+        Column.from_numpy(np.array([5, 1, 0, -4, 7, 0], np.int64), valid),
+        Column.from_numpy(np.array([5.5, 1.25, -0.0, np.pi, 1e300, 0.0]), valid),
+        Column.from_numpy(np.array([5, 1, 0, -42, 2**31 - 1, 0], np.int32), valid),
+        Column.from_numpy(np.array([1, 0, 1, 1, 0, 0], np.bool_), valid),
+        Column.from_numpy(np.array([5.5, 1.5, -9.9, 3.14, 1e30, 0], np.float32),
+                          valid),
+        Column.from_numpy(np.array([5, 1, 0, -8, 127, 0], np.int8), valid),
+        Column.fixed(dt.decimal32(-3),
+                     np.array([5100, 1230, 0, -88888, 123456, 0], np.int32),
+                     valid),
+        Column.fixed(dt.decimal64(-8),
+                     np.array([591, 212, 0, -11111111, 9999999999, 0], np.int64),
+                     valid),
+    ])
+
+
+def assert_tables_equal(got: Table, want: Table):
+    assert got.num_columns == want.num_columns
+    assert got.num_rows == want.num_rows
+    for i, (g, w) in enumerate(zip(got.columns, want.columns)):
+        assert g.dtype == w.dtype, i
+        gv, wv = g.validity_numpy(), w.validity_numpy()
+        np.testing.assert_array_equal(gv, wv, err_msg=f"col {i} validity")
+        gd, wd = np.asarray(g.data), np.asarray(w.data)
+        np.testing.assert_array_equal(gd[wv], wd[wv], err_msg=f"col {i} data")
+
+
+def test_python_client_roundtrip(server):
+    c = BridgeClient(server)
+    t = reference_test_table()
+    schema = t.dtypes()
+
+    h = c.import_table(t)
+    blobs = c.convert_to_rows(h)
+    assert len(blobs) == 1  # 6 rows never overflow a batch
+
+    offs, raw = c.export_rows_column(blobs[0])
+    assert offs.shape[0] == 7 and offs[-1] == raw.shape[0]
+    row_size = offs[1] - offs[0]
+    assert (np.diff(offs) == row_size).all()
+
+    h2 = c.convert_from_rows(blobs[0], schema)
+    nrows, meta = c.table_meta(h2)
+    assert nrows == 6 and meta == schema
+    got = c.export_table(h2)
+    assert_tables_equal(got, t)
+
+    # close discipline + leak check
+    for handle in [h, blobs[0], h2]:
+        c.release(handle)
+    assert c.live_count() == 0
+    with pytest.raises(RuntimeError, match="invalid or released"):
+        c.release(h)  # double release errors, server stays up
+    c.ping()
+    c.close()
+
+
+def test_string_column_import_export(server):
+    c = BridgeClient(server)
+    t = Table([
+        Column.from_pylist(["spark", "", None, "rapids", "tpu"]),
+        Column.from_numpy(np.arange(5, dtype=np.int64)),
+    ])
+    h = c.import_table(t)
+    got = c.export_table(h)
+    assert got.columns[0].to_pylist() == ["spark", "", None, "rapids", "tpu"]
+    np.testing.assert_array_equal(np.asarray(got.columns[1].data), np.arange(5))
+    c.release(h)
+    assert c.live_count() == 0
+    c.close()
+
+
+def test_error_discipline(server):
+    """CATCH_STD analog: bad requests error back; the server survives."""
+    c = BridgeClient(server)
+    with pytest.raises(RuntimeError, match="invalid or released"):
+        c.convert_to_rows(999999)
+    t = Table([Column.from_numpy(np.arange(4, dtype=np.int64))])
+    h = c.import_table(t)
+    with pytest.raises(RuntimeError):  # table handle where column expected
+        c.convert_from_rows(h, [dt.INT64])
+    blobs = c.convert_to_rows(h)
+    with pytest.raises(RuntimeError, match="width mismatch"):
+        c.convert_from_rows(blobs[0], [dt.INT8])  # wrong schema
+    for x in [h, *blobs]:
+        c.release(x)
+    assert c.live_count() == 0
+    c.close()
+
+
+def _native_built() -> bool:
+    if os.path.exists(C_HARNESS):
+        return True
+    if shutil.which("cmake") is None:
+        return False
+    try:
+        subprocess.run(["cmake", "-S", os.path.join(REPO, "src/main/cpp"),
+                        "-B", NATIVE_BUILD, "-G", "Ninja"],
+                       check=True, capture_output=True, timeout=120)
+        subprocess.run(["cmake", "--build", NATIVE_BUILD],
+                       check=True, capture_output=True, timeout=300)
+    except (subprocess.SubprocessError, OSError):
+        return False
+    return os.path.exists(C_HARNESS)
+
+
+def test_c_abi_roundtrip(server):
+    """The real thing: native client, C ABI, only handles cross per-op."""
+    if not _native_built():
+        pytest.skip("native toolchain unavailable")
+    out = subprocess.run([C_HARNESS, server], capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, f"\nstdout:{out.stdout}\nstderr:{out.stderr}"
+    assert "0 leaks" in out.stdout
