@@ -57,11 +57,17 @@ def spawn_server(sock_path: str, env: dict | None = None,
     raise TimeoutError("bridge server did not come up")
 
 
+import itertools
+
+# process-global so concurrent BridgeClient instances (one per task thread)
+# never produce colliding shm names; next() is atomic under the GIL
+_IMP_COUNTER = itertools.count(1)
+
+
 class BridgeClient:
     def __init__(self, sock_path: str):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(sock_path)
-        self._imp_counter = 0
 
     # -- plumbing ----------------------------------------------------------
     def _call(self, opcode: int, payload: bytes = b"") -> bytes:
@@ -85,8 +91,7 @@ class BridgeClient:
     # -- handle ops ----------------------------------------------------------
     def import_table(self, table: Table) -> int:
         """Stage a host table through shm; returns its device handle."""
-        self._imp_counter += 1
-        name = f"tpub-imp-{os.getpid()}-{self._imp_counter}"
+        name = f"tpub-imp-{os.getpid()}-{next(_IMP_COUNTER)}"
         seg = shmlib.SegmentWriter(name)
         descs = []
         for c in table.columns:

@@ -22,6 +22,7 @@ import argparse
 import os
 import socket
 import struct
+import threading
 
 import numpy as np
 
@@ -109,11 +110,27 @@ def _export_column_desc(exp: shmlib.SegmentWriter, col: Column) -> bytes:
 
 
 class BridgeServer:
+    """Serves many clients concurrently (thread per connection).
+
+    A Spark executor JVM runs many task threads; the reference handles the
+    matching concurrency with per-thread CUDA streams (reference pom.xml:80).
+    Here each connection gets a thread and ``_dispatch_lock`` serializes the
+    actual op execution — the handle table and export map are plain dicts,
+    and op work is one JAX dispatch anyway (XLA queues device work; slicing
+    the Python-side critical section thinner buys nothing).  What concurrency
+    buys: a slow client (mid-import, or idle) never blocks another client's
+    requests from being *accepted* and interleaved between its ops.
+    """
+
     def __init__(self, sock_path: str):
         self.sock_path = sock_path
         self.handles = HandleTable()
         self._exports: dict[str, object] = {}  # shm name -> mmap
         self._exp_counter = 0
+        self._dispatch_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._conns_lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
 
     # -- op implementations ------------------------------------------------
     def _op_import_table(self, payload: bytes) -> bytes:
@@ -241,43 +258,83 @@ class BridgeServer:
             pass
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         srv.bind(self.sock_path)
-        srv.listen(4)
+        srv.listen(16)
+        workers: list[threading.Thread] = []
         try:
-            run = True
-            while run:
-                conn, _ = srv.accept()
-                with conn:
-                    run = self._serve_client(conn)
+            while not self._shutdown.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    break  # socket closed by the shutdown handler
+                t = threading.Thread(target=self._serve_client, args=(conn,),
+                                     daemon=True)
+                t.start()
+                workers.append(t)
         finally:
             srv.close()
+            # unblock workers parked in recv on idle connections, then wait
+            with self._conns_lock:
+                for c in list(self._conns):
+                    try:
+                        c.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+            for t in workers:
+                t.join(timeout=5)
             try:
                 os.unlink(self.sock_path)
             except FileNotFoundError:
                 pass
             for name, m in self._exports.items():
-                m.close()
-                shmlib.unlink(name)
+                try:
+                    m.close()
+                    shmlib.unlink(name)
+                except (BufferError, OSError):
+                    pass  # a straggler worker still maps it; best-effort
 
-    def _serve_client(self, conn: socket.socket) -> bool:
-        """Returns False when a SHUTDOWN was processed."""
-        while True:
-            try:
-                opcode, payload = P.recv_msg(conn)
-            except ConnectionError:
-                return True  # client went away; await the next one
-            if opcode == P.OP_SHUTDOWN:
-                P.send_msg(conn, P.STATUS_OK)
-                return False
-            try:
-                out = self._dispatch(opcode, payload)
-            except Exception as e:  # noqa: BLE001 — CATCH_STD analog
-                status, resp = P.STATUS_ERROR, f"{type(e).__name__}: {e}".encode()
-            else:
-                status, resp = P.STATUS_OK, out
-            try:
-                P.send_msg(conn, status, resp)
-            except (BrokenPipeError, ConnectionError):
-                return True  # client died mid-reply; keep serving others
+    def _serve_client(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            self._client_loop(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._shutdown.is_set():
+                try:
+                    opcode, payload = P.recv_msg(conn)
+                except ConnectionError:
+                    return  # client went away; others keep running
+                if opcode == P.OP_SHUTDOWN:
+                    try:
+                        P.send_msg(conn, P.STATUS_OK)
+                    except (BrokenPipeError, ConnectionError):
+                        pass
+                    self._shutdown.set()
+                    # unblock the accept() loop
+                    try:
+                        poke = socket.socket(socket.AF_UNIX,
+                                             socket.SOCK_STREAM)
+                        poke.connect(self.sock_path)
+                        poke.close()
+                    except OSError:
+                        pass
+                    return
+                try:
+                    with self._dispatch_lock:
+                        out = self._dispatch(opcode, payload)
+                except Exception as e:  # noqa: BLE001 — CATCH_STD analog
+                    status, resp = (P.STATUS_ERROR,
+                                    f"{type(e).__name__}: {e}".encode())
+                else:
+                    status, resp = P.STATUS_OK, out
+                try:
+                    P.send_msg(conn, status, resp)
+                except (BrokenPipeError, ConnectionError):
+                    return  # client died mid-reply; keep serving others
 
 
 def serve(sock_path: str) -> None:

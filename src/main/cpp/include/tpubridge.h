@@ -12,6 +12,12 @@
  *
  * All functions return 0 on success, negative on failure;
  * tpub_last_error(ctx) returns the last error message (CATCH_STD analog).
+ *
+ * Thread safety: a tpub_ctx may be shared by many threads — each op's
+ * request/response round trip is serialized internally, so concurrent calls
+ * never interleave protocol frames.  tpub_last_error is best-effort under
+ * concurrency (read it on the thread whose call failed, before issuing
+ * another call from that thread).
  */
 #ifndef TPUBRIDGE_H
 #define TPUBRIDGE_H
@@ -53,9 +59,18 @@ int tpub_import_table(tpub_ctx *ctx, const tpub_col *cols, int32_t ncols,
                       uint64_t *out);
 
 /* RowConversion.convertToRows: table handle -> up to *count blob-column
- * handles written to out[]; *count holds capacity in, result count out. */
+ * handles written to out[]; *count holds capacity in, result count out.
+ * On a too-small buffer the already-created batches are released server-side
+ * (no leak), *count is set to the required size, and -1 is returned. */
 int tpub_convert_to_rows(tpub_ctx *ctx, uint64_t table, uint64_t *out,
                          int32_t *count);
+
+/* Like tpub_convert_to_rows but sized by the response: *out receives a
+ * malloc'd handle array of length *count (no batch-count cap).  Free with
+ * tpub_free_handles. */
+int tpub_convert_to_rows_alloc(tpub_ctx *ctx, uint64_t table, uint64_t **out,
+                               int32_t *count);
+void tpub_free_handles(uint64_t *handles);
 
 /* RowConversion.convertFromRows: LIST<INT8> column handle + flattened
  * (type_id, scale) schema -> table handle. */

@@ -11,10 +11,12 @@
  */
 #include "tpubridge.h"
 
+#include <atomic>
 #include <cerrno>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -59,6 +61,23 @@ T get(const uint8_t *p) {
 }
 
 int64_t align8(int64_t x) { return (x + 7) & ~int64_t(7); }
+
+/* storage width of a fixed-width cudf-compatible type id (dtypes.py TypeId);
+ * 0 = variable-width or unknown (then dlen can't be cross-checked) */
+uint64_t type_width(int32_t tid) {
+  switch (tid) {
+    case 1: case 5: case 11: return 1;              /* INT8 UINT8 BOOL8 */
+    case 2: case 6: return 2;                       /* INT16 UINT16 */
+    case 3: case 7: case 9: case 12: case 25: return 4; /* 32-bit + DEC32 */
+    case 4: case 8: case 10: return 8;              /* INT64 UINT64 FLOAT64 */
+    case 13: case 14: case 15: case 16: return 8;   /* TIMESTAMP_* (s..ns) */
+    case 18: case 19: case 20: case 21: return 8;   /* DURATION_* (s..ns) */
+    case 17: return 4;                              /* DURATION_DAYS */
+    case 26: return 8;                              /* DECIMAL64 */
+    case 27: return 16;                             /* DECIMAL128 */
+    default: return 0;
+  }
+}
 
 struct Shm {
   std::string name; /* without leading slash, as on the wire */
@@ -105,9 +124,18 @@ struct Shm {
 struct tpub_ctx {
   int sock = -1;
   std::string last_error;
-  uint64_t imp_counter = 0;
+  std::atomic<uint64_t> imp_counter{0};
+  /* Serializes whole request/response round trips: concurrent JVM task
+   * threads share one connection (tpubridge_jni.cpp), and interleaved
+   * frames would corrupt the protocol stream.  The analog of the
+   * reference's per-thread-stream discipline is per-call exclusion here. */
+  std::mutex mu;
+  /* Guards last_error alone (fail() runs on paths outside mu, and reads
+   * via tpub_last_error may race other threads' failures). */
+  std::mutex err_mu;
 
   int fail(const std::string &msg) {
+    std::lock_guard<std::mutex> lock(err_mu);
     last_error = msg;
     return -1;
   }
@@ -143,6 +171,7 @@ struct tpub_ctx {
   /* one request/response round trip; resp gets the payload after status */
   int call(uint8_t opcode, const std::vector<uint8_t> &payload,
            std::vector<uint8_t> &resp) {
+    std::lock_guard<std::mutex> lock(mu);
     uint32_t body_len = 1 + (uint32_t)payload.size();
     std::vector<uint8_t> hdr;
     put<uint32_t>(hdr, body_len);
@@ -157,8 +186,8 @@ struct tpub_ctx {
     std::vector<uint8_t> body(rlen);
     if (recv_all(body.data(), rlen) != 0) return -1;
     if (body[0] != STATUS_OK) {
-      last_error.assign((const char *)body.data() + 1, body.size() - 1);
-      return -1;
+      return fail(std::string((const char *)body.data() + 1,
+                              body.size() - 1));
     }
     resp.assign(body.begin() + 1, body.end());
     return 0;
@@ -189,7 +218,14 @@ void tpub_disconnect(tpub_ctx *ctx) {
 }
 
 const char *tpub_last_error(tpub_ctx *ctx) {
-  return ctx ? ctx->last_error.c_str() : "null context";
+  if (!ctx) return "null context";
+  /* copy under the error lock into a thread-local buffer: the returned
+   * pointer stays valid for this thread even if another thread fails and
+   * reallocates ctx->last_error concurrently */
+  thread_local std::string tl_err;
+  std::lock_guard<std::mutex> lock(ctx->err_mu);
+  tl_err = ctx->last_error;
+  return tl_err.c_str();
 }
 
 int tpub_ping(tpub_ctx *ctx) {
@@ -269,19 +305,56 @@ int tpub_import_table(tpub_ctx *ctx, const tpub_col *cols, int32_t ncols,
   return 0;
 }
 
-int tpub_convert_to_rows(tpub_ctx *ctx, uint64_t table, uint64_t *out,
-                         int32_t *count) {
+static int to_rows_impl(tpub_ctx *ctx, uint64_t table,
+                        std::vector<uint64_t> &handles) {
   std::vector<uint8_t> payload, resp;
   put<uint64_t>(payload, table);
   if (ctx->call(OP_TO_ROWS, payload, resp) != 0) return -1;
   if (resp.size() < 4) return ctx->fail("bad to_rows response");
   int32_t nb = (int32_t)get<uint32_t>(resp.data());
-  if (nb > *count) return ctx->fail("to_rows: output array too small");
+  if (nb < 0 || resp.size() < 4 + 8 * (size_t)nb)
+    return ctx->fail("truncated to_rows response");
+  handles.resize((size_t)nb);
   for (int32_t i = 0; i < nb; ++i)
-    out[i] = get<uint64_t>(resp.data() + 4 + 8 * (size_t)i);
+    handles[(size_t)i] = get<uint64_t>(resp.data() + 4 + 8 * (size_t)i);
+  return 0;
+}
+
+int tpub_convert_to_rows(tpub_ctx *ctx, uint64_t table, uint64_t *out,
+                         int32_t *count) {
+  std::vector<uint64_t> handles;
+  if (to_rows_impl(ctx, table, handles) != 0) return -1;
+  int32_t nb = (int32_t)handles.size();
+  if (nb > *count) {
+    /* release the already-created batches before failing, so a too-small
+     * caller buffer never leaks device objects */
+    for (uint64_t h : handles) tpub_release(ctx, h);
+    *count = nb; /* tell the caller the size it needs */
+    return ctx->fail("to_rows: output array too small");
+  }
+  for (int32_t i = 0; i < nb; ++i) out[i] = handles[(size_t)i];
   *count = nb;
   return 0;
 }
+
+int tpub_convert_to_rows_alloc(tpub_ctx *ctx, uint64_t table, uint64_t **out,
+                               int32_t *count) {
+  std::vector<uint64_t> handles;
+  if (to_rows_impl(ctx, table, handles) != 0) return -1;
+  auto *arr = (uint64_t *)std::malloc(
+      handles.empty() ? 1 : handles.size() * sizeof(uint64_t));
+  if (!arr) {
+    for (uint64_t h : handles) tpub_release(ctx, h);
+    return ctx->fail("oom");
+  }
+  if (!handles.empty())
+    std::memcpy(arr, handles.data(), handles.size() * sizeof(uint64_t));
+  *out = arr;
+  *count = (int32_t)handles.size();
+  return 0;
+}
+
+void tpub_free_handles(uint64_t *handles) { std::free(handles); }
 
 int tpub_convert_from_rows(tpub_ctx *ctx, uint64_t column,
                            const int32_t *type_ids, const int32_t *scales,
@@ -321,18 +394,30 @@ int tpub_export_table(tpub_ctx *ctx, uint64_t table, tpub_export *out) {
   std::vector<uint8_t> payload, resp;
   put<uint64_t>(payload, table);
   if (ctx->call(OP_EXPORT_TABLE, payload, resp) != 0) return -1;
+  /* never trust server-supplied sizes: validate every extent against the
+   * response and shm segment before dereferencing */
+  if (resp.size() < 4) return ctx->fail("truncated export response");
   const uint8_t *p = resp.data();
   uint32_t nlen = get<uint32_t>(p);
+  if (resp.size() < 4 + (size_t)nlen + 12)
+    return ctx->fail("truncated export response");
   std::string name((const char *)p + 4, nlen);
   p += 4 + nlen;
   uint64_t shm_size = get<uint64_t>(p);
   int32_t ncols = (int32_t)get<uint32_t>(p + 8);
   p += 12;
+  size_t desc_avail = resp.size() - (4 + (size_t)nlen + 12);
+  if (ncols < 0 || desc_avail < 49 * (size_t)ncols)
+    return ctx->fail("truncated export descriptors");
 
   Shm shm;
   if (shm.attach(name) != 0) {
     free_remote_shm(ctx, name);
     return ctx->fail("export shm attach failed");
+  }
+  if ((uint64_t)shm.size < shm_size) {
+    free_remote_shm(ctx, name);
+    return ctx->fail("export shm smaller than advertised");
   }
   /* single owned block: copy of the whole shm + descriptor array */
   size_t block_sz = (size_t)shm_size + sizeof(tpub_col) * (size_t)ncols;
@@ -341,23 +426,43 @@ int tpub_export_table(tpub_ctx *ctx, uint64_t table, tpub_export *out) {
   std::memcpy(block, shm.map, (size_t)shm_size);
   auto *cols = (tpub_col *)(block + shm_size);
 
+  const uint8_t *end = resp.data() + resp.size();
+  auto in_shm = [shm_size](uint64_t off, uint64_t len) {
+    return off <= shm_size && len <= shm_size - off;
+  };
   for (int32_t i = 0; i < ncols; ++i) {
     tpub_col &c = cols[i];
+    if (end - p < 49) goto bad;
     c.type_id = get<int32_t>(p);
     c.scale = get<int32_t>(p + 4);
     c.nrows = get<int64_t>(p + 8);
-    uint8_t hasv = p[16];
-    uint64_t doff = get<uint64_t>(p + 17), dlen = get<uint64_t>(p + 25);
-    uint64_t voff = get<uint64_t>(p + 33), vlen = get<uint64_t>(p + 41);
-    p += 49;
-    c.data = block + doff;
-    c.data_len = (int64_t)dlen;
-    c.validity = hasv ? block + voff : nullptr;
-    (void)vlen;
+    {
+      uint8_t hasv = p[16];
+      uint64_t doff = get<uint64_t>(p + 17), dlen = get<uint64_t>(p + 25);
+      uint64_t voff = get<uint64_t>(p + 33), vlen = get<uint64_t>(p + 41);
+      p += 49;
+      if (c.nrows < 0) goto bad;
+      if (!in_shm(doff, dlen) || (hasv && !in_shm(voff, vlen))) goto bad;
+      /* the buffers must actually cover the advertised row count: a consumer
+       * iterates nrows elements of c.data / nrows bytes of c.validity */
+      uint64_t w = type_width(c.type_id);
+      if (w != 0 && dlen / w < (uint64_t)c.nrows) goto bad;
+      if (hasv && vlen < (uint64_t)c.nrows) goto bad;
+      c.data = block + doff;
+      c.data_len = (int64_t)dlen;
+      c.validity = hasv ? block + voff : nullptr;
+    }
     if (c.type_id == 23 /* STRING */) {
-      uint64_t ooff = get<uint64_t>(p);
+      if (end - p < 16) goto bad;
+      uint64_t ooff = get<uint64_t>(p), olen = get<uint64_t>(p + 8);
       p += 16;
-      c.offsets = (const int32_t *)(block + ooff);
+      /* int32 offsets[nrows+1], and the final offset must stay inside the
+       * char buffer consumers slice with it */
+      if (!in_shm(ooff, olen) || olen / 4 < (uint64_t)c.nrows + 1) goto bad;
+      const int32_t *offs = (const int32_t *)(block + ooff);
+      if (offs[c.nrows] < 0 || (uint64_t)offs[c.nrows] > (uint64_t)c.data_len)
+        goto bad;
+      c.offsets = offs;
     } else {
       c.offsets = nullptr;
     }
@@ -367,6 +472,10 @@ int tpub_export_table(tpub_ctx *ctx, uint64_t table, tpub_export *out) {
   out->ncols = ncols;
   out->block = block;
   return 0;
+bad:
+  std::free(block);
+  free_remote_shm(ctx, name);
+  return ctx->fail("malformed export descriptors");
 }
 
 void tpub_free_export(tpub_export *e) {
@@ -381,28 +490,45 @@ int tpub_export_rows(tpub_ctx *ctx, uint64_t column, tpub_rows *out) {
   std::vector<uint8_t> payload, resp;
   put<uint64_t>(payload, column);
   if (ctx->call(OP_EXPORT_COLUMN, payload, resp) != 0) return -1;
+  if (resp.size() < 4) return ctx->fail("truncated rows response");
   const uint8_t *p = resp.data();
   uint32_t nlen = get<uint32_t>(p);
+  if (resp.size() < 4 + (size_t)nlen + 48)
+    return ctx->fail("truncated rows response");
   std::string name((const char *)p + 4, nlen);
   p += 4 + nlen;
   uint64_t shm_size = get<uint64_t>(p);
   int64_t nrows = get<int64_t>(p + 8);
   uint64_t ooff = get<uint64_t>(p + 16), olen = get<uint64_t>(p + 24);
   uint64_t doff = get<uint64_t>(p + 32), dlen = get<uint64_t>(p + 40);
-  (void)olen;
+  if (nrows < 0 || ooff > shm_size || olen > shm_size - ooff ||
+      doff > shm_size || dlen > shm_size - doff ||
+      olen / 4 < (uint64_t)nrows + 1) {
+    free_remote_shm(ctx, name);
+    return ctx->fail("malformed rows descriptors");
+  }
 
   Shm shm;
   if (shm.attach(name) != 0) {
     free_remote_shm(ctx, name);
     return ctx->fail("rows shm attach failed");
   }
+  if ((uint64_t)shm.size < shm_size) {
+    free_remote_shm(ctx, name);
+    return ctx->fail("rows shm smaller than advertised");
+  }
   auto *block = (uint8_t *)std::malloc((size_t)shm_size ? (size_t)shm_size : 1);
   if (!block) { free_remote_shm(ctx, name); return ctx->fail("oom"); }
   std::memcpy(block, shm.map, (size_t)shm_size);
   free_remote_shm(ctx, name);
 
+  const int32_t *offs = (const int32_t *)(block + ooff);
+  if (offs[nrows] < 0 || (uint64_t)offs[nrows] > dlen) {
+    std::free(block);
+    return ctx->fail("rows offsets exceed data buffer");
+  }
   out->nrows = nrows;
-  out->offsets = (const int32_t *)(block + ooff);
+  out->offsets = offs;
   out->data = block + doff;
   out->data_len = (int64_t)dlen;
   out->block = block;

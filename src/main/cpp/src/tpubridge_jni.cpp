@@ -14,13 +14,18 @@
 #include <jni.h>
 
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <vector>
 
 #include "../include/tpubridge.h"
 
 namespace {
-tpub_ctx *g_ctx = nullptr;
+/* Shared-ptr holder so a disconnect racing in-flight ops can never free the
+ * context under them: each entry point takes a reference under g_mu and the
+ * context dies only when the last in-flight op drops it.  Per-op protocol
+ * serialization lives inside tpub_ctx::call (tpubridge.cpp). */
+std::shared_ptr<tpub_ctx> g_ctx;
 std::mutex g_mu;
 
 void throw_runtime(JNIEnv *env, const char *msg) {
@@ -28,7 +33,8 @@ void throw_runtime(JNIEnv *env, const char *msg) {
   if (cls) env->ThrowNew(cls, msg);
 }
 
-tpub_ctx *ctx_or_throw(JNIEnv *env) {
+std::shared_ptr<tpub_ctx> ctx_or_throw(JNIEnv *env) {
+  std::lock_guard<std::mutex> lock(g_mu);
   if (!g_ctx) throw_runtime(env, "TpuBridge.connect() has not been called");
   return g_ctx;
 }
@@ -42,55 +48,61 @@ Java_com_nvidia_spark_rapids_jni_TpuBridge_connectNative(JNIEnv *env, jclass,
   std::lock_guard<std::mutex> lock(g_mu);
   if (g_ctx) return JNI_TRUE;
   const char *path = env->GetStringUTFChars(jpath, nullptr);
-  g_ctx = tpub_connect(path);
+  tpub_ctx *raw = tpub_connect(path);
   env->ReleaseStringUTFChars(jpath, path);
-  if (!g_ctx) throw_runtime(env, "cannot connect to device server");
-  return g_ctx ? JNI_TRUE : JNI_FALSE;
+  if (!raw) {
+    throw_runtime(env, "cannot connect to device server");
+    return JNI_FALSE;
+  }
+  g_ctx = std::shared_ptr<tpub_ctx>(raw, tpub_disconnect);
+  return JNI_TRUE;
 }
 
 JNIEXPORT void JNICALL
 Java_com_nvidia_spark_rapids_jni_TpuBridge_disconnectNative(JNIEnv *, jclass) {
   std::lock_guard<std::mutex> lock(g_mu);
-  if (g_ctx) {
-    tpub_disconnect(g_ctx);
-    g_ctx = nullptr;
-  }
+  g_ctx.reset(); /* deleter (tpub_disconnect) runs when in-flight ops drain */
 }
 
 JNIEXPORT jlongArray JNICALL
 Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRows(JNIEnv *env,
                                                              jclass,
                                                              jlong table) {
-  tpub_ctx *ctx = ctx_or_throw(env);
+  auto ctx = ctx_or_throw(env);
   if (!ctx) return nullptr;
-  uint64_t out[64];
-  int32_t count = 64;
-  if (tpub_convert_to_rows(ctx, (uint64_t)table, out, &count) != 0) {
-    throw_runtime(env, tpub_last_error(ctx));
+  uint64_t *out = nullptr;
+  int32_t count = 0;
+  /* sized by the response — no batch-count cap (a >2GB-per-batch table
+   * returns as many LIST<INT8> batches as the 2^31-byte split produces) */
+  if (tpub_convert_to_rows_alloc(ctx.get(), (uint64_t)table, &out, &count)
+      != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
     return nullptr;
   }
   jlongArray arr = env->NewLongArray(count);
-  if (!arr) return nullptr;
-  std::vector<jlong> tmp(out, out + count);
-  env->SetLongArrayRegion(arr, 0, count, tmp.data());
+  if (arr) {
+    std::vector<jlong> tmp(out, out + count);
+    env->SetLongArrayRegion(arr, 0, count, tmp.data());
+  }
+  tpub_free_handles(out);
   return arr;
 }
 
 JNIEXPORT jlong JNICALL
 Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
     JNIEnv *env, jclass, jlong column, jintArray jtypes, jintArray jscales) {
-  tpub_ctx *ctx = ctx_or_throw(env);
+  auto ctx = ctx_or_throw(env);
   if (!ctx) return 0;
   jsize n = env->GetArrayLength(jtypes);
   std::vector<jint> types(n), scales(n);
   env->GetIntArrayRegion(jtypes, 0, n, types.data());
   env->GetIntArrayRegion(jscales, 0, n, scales.data());
   uint64_t out = 0;
-  if (tpub_convert_from_rows(ctx, (uint64_t)column,
+  if (tpub_convert_from_rows(ctx.get(), (uint64_t)column,
                              (const int32_t *)types.data(),
                              (const int32_t *)scales.data(), (int32_t)n,
                              &out) != 0) {
-    throw_runtime(env, tpub_last_error(ctx));
+    throw_runtime(env, tpub_last_error(ctx.get()));
     return 0;
   }
   return (jlong)out;
@@ -99,20 +111,20 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
 JNIEXPORT void JNICALL
 Java_com_nvidia_spark_rapids_jni_TpuBridge_releaseNative(JNIEnv *env, jclass,
                                                          jlong handle) {
-  tpub_ctx *ctx = ctx_or_throw(env);
+  auto ctx = ctx_or_throw(env);
   if (!ctx) return;
-  if (tpub_release(ctx, (uint64_t)handle) != 0)
-    throw_runtime(env, tpub_last_error(ctx));
+  if (tpub_release(ctx.get(), (uint64_t)handle) != 0)
+    throw_runtime(env, tpub_last_error(ctx.get()));
 }
 
 JNIEXPORT jint JNICALL
 Java_com_nvidia_spark_rapids_jni_TpuBridge_liveCountNative(JNIEnv *env,
                                                            jclass) {
-  tpub_ctx *ctx = ctx_or_throw(env);
+  auto ctx = ctx_or_throw(env);
   if (!ctx) return -1;
   int32_t n = 0;
-  if (tpub_live_count(ctx, &n) != 0) {
-    throw_runtime(env, tpub_last_error(ctx));
+  if (tpub_live_count(ctx.get(), &n) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
     return -1;
   }
   return (jint)n;

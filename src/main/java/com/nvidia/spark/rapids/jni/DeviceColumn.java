@@ -13,7 +13,9 @@ public final class DeviceColumn implements AutoCloseable {
     this.handle = handle;
   }
 
-  public long getHandle() {
+  // synchronized with close(): a handle read concurrently with a close
+  // must either see the live handle or throw, never a released value
+  public synchronized long getHandle() {
     if (handle == 0) {
       throw new IllegalStateException("column already closed");
     }

@@ -133,6 +133,59 @@ def test_error_discipline(server):
     c.close()
 
 
+def test_concurrent_clients(server):
+    """Two clients mid-flight: connection B is serviced while connection A
+    sits idle between ops (a serial accept loop would block B forever), and
+    interleaved ops from many threads keep handle bookkeeping consistent."""
+    import threading
+
+    a = BridgeClient(server)  # held open and idle across B's whole session
+    ha = a.import_table(
+        Table([Column.from_numpy(np.arange(8, dtype=np.int64))]))
+
+    b = BridgeClient(server)
+    hb = b.import_table(
+        Table([Column.from_numpy(np.arange(4, dtype=np.int64))]))
+    got = b.export_table(hb)
+    np.testing.assert_array_equal(np.asarray(got.columns[0].data),
+                                  np.arange(4))
+    b.release(hb)
+    b.close()
+
+    # A's connection still works after B's session completed in between
+    got_a = a.export_table(ha)
+    assert got_a.num_rows == 8
+
+    errors = []
+
+    def hammer(i):
+        try:
+            c = BridgeClient(server)
+            t = Table([Column.from_numpy(np.arange(16, dtype=np.int64) + i)])
+            for _ in range(10):
+                h = c.import_table(t)
+                blobs = c.convert_to_rows(h)
+                h2 = c.convert_from_rows(blobs[0], [dt.INT64])
+                out = c.export_table(h2)
+                assert np.asarray(out.columns[0].data)[0] == i
+                for x in [h, *blobs, h2]:
+                    c.release(x)
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+    a.release(ha)
+    assert a.live_count() == 0
+    a.close()
+
+
 def _native_built() -> bool:
     if os.path.exists(C_HARNESS):
         return True
