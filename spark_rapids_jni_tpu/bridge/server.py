@@ -269,6 +269,7 @@ class BridgeServer:
                 t = threading.Thread(target=self._serve_client, args=(conn,),
                                      daemon=True)
                 t.start()
+                workers = [w for w in workers if w.is_alive()]
                 workers.append(t)
         finally:
             srv.close()

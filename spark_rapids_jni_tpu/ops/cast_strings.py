@@ -336,9 +336,13 @@ def cast_from_integer(col: Column) -> Column:
             and col.dtype.id != TypeId.BOOL8:
         raise TypeError(f"expected integral column, got {col.dtype!r}")
     if col.dtype.id == TypeId.BOOL8:
-        strs = ["true" if v else "false" if v is not None else None
-                for v in col.to_pylist()]
-        return Column.from_pylist(strs, STRING)
+        # device select between the two literal byte rows (was a host loop)
+        tmat = jnp.asarray(np.frombuffer(b"true\0", dtype=np.uint8))
+        fmat = jnp.asarray(np.frombuffer(b"false", dtype=np.uint8))
+        truth = jnp.asarray(col.data) != 0
+        mat = jnp.where(truth[:, None], tmat[None, :], fmat[None, :])
+        lengths = jnp.where(truth, 4, 5).astype(jnp.int32)
+        return from_padded_bytes(mat, lengths, col.validity)
     vals = jnp.asarray(col.data).astype(jnp.int64)
     mat, lengths = _int_to_digit_matrix(vals, 21)
     return from_padded_bytes(mat, lengths, col.validity)

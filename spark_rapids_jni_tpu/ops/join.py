@@ -72,32 +72,60 @@ def _pair_equal(lcol: Column, rcol: Column, li, ri, null_equal: bool):
     return eq
 
 
-def _candidates(left: Table, right: Table, on_left, on_right):
-    """Device candidate ranges + host pair count; returns (li, ri, eq)."""
-    lk = _key_table(left, on_left)
-    rk = _key_table(right, on_right)
-    lh = xxhash64(lk).data
-    rh = xxhash64(rk).data
+def _probe_ranges(lh, rh):
+    """Sorted-probe prelude: one sort of the build side, per-probe ranges.
 
+    Returns (r_order, offsets, starts, expansion) where probe row i's
+    candidates occupy sorted positions [lo, hi) recoverable from
+    starts/offsets, and ``expansion`` is the total candidate-pair count.
+    """
     r_order = jnp.argsort(rh)
     rh_sorted = jnp.take(rh, r_order)
     lo = jnp.searchsorted(rh_sorted, lh, side="left").astype(_I32)
     hi = jnp.searchsorted(rh_sorted, lh, side="right").astype(_I32)
     counts = (hi - lo).astype(jnp.int64)
     offsets = jnp.cumsum(counts)
-    total = int(offsets[-1]) if counts.shape[0] else 0  # host sync: join size
+    starts = offsets - counts
+    expansion = offsets[-1] if counts.shape[0] else jnp.int64(0)
+    return r_order, lo, offsets, starts, expansion
+
+
+def _expand_pairs(r_order, lo, offsets, starts, nl, nr, total):
+    """Enumerate candidate pairs 0..total over precomputed probe ranges.
+
+    ``total`` may be a host int (exact size) or a static capacity; pairs
+    beyond the true expansion get in_range=False.
+    """
+    j = jnp.arange(total, dtype=jnp.int64)
+    li = jnp.searchsorted(offsets, j, side="right").astype(_I32)
+    in_range = li < nl
+    li = jnp.clip(li, 0, max(nl - 1, 0))
+    within = (j - jnp.take(starts, li)).astype(_I32)
+    ri_sorted_pos = jnp.clip(jnp.take(lo, li) + within, 0, max(nr - 1, 0))
+    ri = jnp.take(r_order, ri_sorted_pos).astype(_I32)
+    return li, ri, in_range
+
+
+def _candidates(left: Table, right: Table, on_left, on_right):
+    """Device candidate pairs + host pair count; returns (li, ri, eq, lk, rk).
+
+    The expansion size is the hash-collision join cardinality — one host
+    scalar sync, the same place cudf returns its gather-map size.
+    """
+    lk = _key_table(left, on_left)
+    rk = _key_table(right, on_right)
+    lh = xxhash64(lk).data
+    rh = xxhash64(rk).data
+
+    r_order, lo, offsets, starts, expansion = _probe_ranges(lh, rh)
+    total = int(expansion) if lh.shape[0] else 0
 
     if total == 0:
         z = jnp.zeros((0,), _I32)
         return z, z, jnp.zeros((0,), jnp.bool_), lk, rk
 
-    starts = offsets - counts
-    j = jnp.arange(total, dtype=jnp.int64)
-    li = jnp.searchsorted(offsets, j, side="right").astype(_I32)
-    within = (j - jnp.take(starts, li)).astype(_I32)
-    ri_sorted_pos = jnp.take(lo, li) + within
-    ri = jnp.take(r_order, ri_sorted_pos).astype(_I32)
-
+    li, ri, _ = _expand_pairs(r_order, lo, offsets, starts,
+                              lh.shape[0], rh.shape[0], total)
     eq = jnp.ones((total,), jnp.bool_)
     for lc, rc in zip(lk.columns, rk.columns):
         eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
@@ -105,9 +133,10 @@ def _candidates(left: Table, right: Table, on_left, on_right):
 
 
 def _compact_pairs(li, ri, eq):
-    keep = np.flatnonzero(np.asarray(eq))
-    return (jnp.asarray(np.asarray(li)[keep]),
-            jnp.asarray(np.asarray(ri)[keep]))
+    """Keep true-equal pairs; device compaction, one scalar host sync."""
+    from .selection import nonzero_indices
+    sel = nonzero_indices(eq)
+    return jnp.take(li, sel), jnp.take(ri, sel)
 
 
 def inner_join(left: Table, right: Table, on_left, on_right=None,
@@ -120,19 +149,52 @@ def inner_join(left: Table, right: Table, on_left, on_right=None,
                      right_valid=None)
 
 
+def inner_join_padded(left: Table, right: Table, on_left, on_right,
+                      capacity: int):
+    """Fully jit-able inner join at a static pair capacity.
+
+    Returns (li, ri, live, npairs, overflow): int32 pair indices padded to
+    ``capacity`` with a live mask, the live pair count, and the count of
+    candidate pairs that didn't fit (an upper bound on lost true pairs).
+    The building block for shard-local joins inside pjit/shard_map
+    (distributed SortMergeJoin) where XLA needs static shapes — the
+    role the 2^31-byte batch split plays in the reference
+    (row_conversion.cu:476-511): a tunable static bound with overflow
+    *counted*, never silently dropped.
+    """
+    on_right = on_right or on_left
+    lk = _key_table(left, on_left)
+    rk = _key_table(right, on_right)
+    lh = xxhash64(lk).data
+    rh = xxhash64(rk).data
+    r_order, lo, offsets, starts, expansion = _probe_ranges(lh, rh)
+    li, ri, in_range = _expand_pairs(r_order, lo, offsets, starts,
+                                     lh.shape[0], rh.shape[0], capacity)
+    eq = in_range
+    for lc, rc in zip(lk.columns, rk.columns):
+        eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
+    # candidate pairs beyond capacity can't be equality-checked at static
+    # shape; overflow is their count (a superset bound on lost true pairs)
+    overflow = jnp.maximum(expansion - capacity, 0)
+    from .selection import nonzero_indices
+    order = nonzero_indices(eq, count=capacity)
+    npairs = jnp.sum(eq.astype(jnp.int32))
+    live = jnp.arange(capacity, dtype=jnp.int32) < npairs
+    return (jnp.take(li, order), jnp.take(ri, order), live, npairs, overflow)
+
+
 def left_join(left: Table, right: Table, on_left, on_right=None,
               suffixes=("", "_r")) -> Table:
     on_right = on_right or on_left
     li, ri, eq, _, _ = _candidates(left, right, on_left, on_right)
-    lin = np.asarray(li)
-    eqn = np.asarray(eq)
-    keep = np.flatnonzero(eqn)
-    matched_rows = np.zeros(left.num_rows, bool)
-    matched_rows[lin[keep]] = True
-    un = np.flatnonzero(~matched_rows)
-    li_all = jnp.asarray(np.concatenate([lin[keep], un]).astype(np.int32))
-    ri_all = jnp.asarray(np.concatenate(
-        [np.asarray(ri)[keep], np.full(un.shape, -1, np.int32)]))
+    from .selection import nonzero_indices
+    matched_rows = jnp.zeros((left.num_rows,), jnp.bool_)
+    if li.shape[0]:
+        matched_rows = matched_rows.at[li].max(eq)
+    li_m, ri_m = _compact_pairs(li, ri, eq)
+    un = nonzero_indices(~matched_rows)
+    li_all = jnp.concatenate([li_m, un]).astype(_I32)
+    ri_all = jnp.concatenate([ri_m, jnp.full(un.shape, -1, _I32)])
     return _assemble(left, right, li_all, ri_all, on_left, on_right, suffixes,
                      right_valid=ri_all >= 0)
 
@@ -142,19 +204,19 @@ def _distinct_reps(table: Table, on):
 
     Bounds semi/anti work by |distinct keys| instead of join cardinality —
     with a hot key, the candidate expansion over raw rows would be quadratic.
+    Device-side throughout; one host sync for the distinct-key count.
     """
     from .order import SortKey, encode_keys, rows_differ_from_prev
+    from .selection import nonzero_indices
     keys = [SortKey(table.column(k)) for k in on]
     words = encode_keys(keys)
     order = jnp.lexsort(tuple(reversed(words)))
     bounds = rows_differ_from_prev(words, order)
     seg = jnp.cumsum(bounds.astype(_I32)) - 1
-    order_np = np.asarray(order)
-    seg_np = np.asarray(seg)
-    seg_of_row = np.empty_like(seg_np)
-    seg_of_row[order_np] = seg_np
-    reps = order_np[np.asarray(bounds)]
-    return reps.astype(np.int32), seg_of_row
+    n = order.shape[0]
+    seg_of_row = jnp.zeros((n,), _I32).at[order].set(seg)
+    reps = jnp.take(order, nonzero_indices(bounds)).astype(_I32)
+    return reps, seg_of_row
 
 
 def _matched_left_rows(left: Table, right: Table, on_left, on_right):
@@ -162,25 +224,28 @@ def _matched_left_rows(left: Table, right: Table, on_left, on_right):
     rreps, _ = _distinct_reps(right, on_right)
     knames = [f"k{i}" for i in range(len(on_left))]
     lrep_t = gather_table(Table([left.column(k) for k in on_left], knames),
-                          jnp.asarray(lreps))
+                          lreps)
     rrep_t = gather_table(Table([right.column(k) for k in on_right], knames),
-                          jnp.asarray(rreps))
+                          rreps)
     li, ri, eq, _, _ = _candidates(lrep_t, rrep_t, knames, knames)
-    matched_unique = np.zeros(len(lreps), bool)
-    matched_unique[np.asarray(li)[np.flatnonzero(np.asarray(eq))]] = True
-    return matched_unique[lseg_of_row]
+    matched_unique = jnp.zeros((lreps.shape[0],), jnp.bool_)
+    if li.shape[0]:
+        matched_unique = matched_unique.at[li].max(eq)
+    return jnp.take(matched_unique, lseg_of_row)
 
 
 def left_semi_join(left: Table, right: Table, on_left, on_right=None) -> Table:
+    from .selection import nonzero_indices
     on_right = on_right or on_left
     matched = _matched_left_rows(left, right, on_left, on_right)
-    return gather_table(left, jnp.asarray(np.flatnonzero(matched), _I32))
+    return gather_table(left, nonzero_indices(matched))
 
 
 def left_anti_join(left: Table, right: Table, on_left, on_right=None) -> Table:
+    from .selection import nonzero_indices
     on_right = on_right or on_left
     matched = _matched_left_rows(left, right, on_left, on_right)
-    return gather_table(left, jnp.asarray(np.flatnonzero(~matched), _I32))
+    return gather_table(left, nonzero_indices(~matched))
 
 
 def _assemble(left, right, li, ri, on_left, on_right, suffixes, right_valid):

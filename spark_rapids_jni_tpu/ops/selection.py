@@ -18,6 +18,22 @@ from .order import SortKey, sort_indices
 from .strings_common import to_padded_bytes, from_padded_bytes
 
 
+def nonzero_indices(mask: jnp.ndarray, count: int | None = None) -> jnp.ndarray:
+    """Device-side ``flatnonzero``: int32 indices of True entries, in order.
+
+    The compaction primitive every data-dependent-size op shares.  A stable
+    argsort moves True rows to the front without leaving the device; only the
+    *count* touches the host (one scalar sync — the same place cudf returns
+    its gather-map size).  Pass a *static* ``count`` (e.g. the full length,
+    or a capacity bound) to stay fully on-device inside jit; the slice size
+    must be trace-time constant.
+    """
+    order = jnp.argsort(jnp.logical_not(mask).astype(jnp.uint8), stable=True)
+    if count is None:
+        count = int(jnp.sum(mask))
+    return order[:count].astype(jnp.int32)
+
+
 def gather_column(col: Column, indices, indices_valid=None) -> Column:
     """Row gather with cudf NULLIFY semantics; supports STRING columns."""
     if not col.dtype.is_string:
@@ -42,15 +58,33 @@ def gather_table(table: Table, indices, indices_valid=None) -> Table:
                   for c in table.columns], table.names)
 
 
-def apply_boolean_mask(table: Table, mask) -> Table:
-    """Keep rows where mask is True (null mask entries drop the row, like
-    Spark filter).  Output size is data-dependent -> host boundary."""
+def _filter_mask(mask) -> jnp.ndarray:
+    """bool[n] keep-mask; null mask entries drop the row (Spark filter)."""
     if isinstance(mask, Column):
-        m = np.asarray(mask.data).astype(bool) & mask.validity_numpy()
-    else:
-        m = np.asarray(mask).astype(bool)
-    idx = jnp.asarray(np.flatnonzero(m), jnp.int32)
-    return gather_table(table, idx)
+        return (mask.data != 0) & mask.valid_mask()
+    return jnp.asarray(mask).astype(jnp.bool_)
+
+
+def apply_boolean_mask(table: Table, mask) -> Table:
+    """Keep rows where mask is True.  Compaction runs on device; only the
+    surviving-row *count* syncs to the host (output shape)."""
+    m = _filter_mask(mask)
+    return gather_table(table, nonzero_indices(m))
+
+
+def apply_boolean_mask_padded(table: Table, mask):
+    """Jit-able filter: rows reordered live-first at full length.
+
+    Returns (padded Table, live row mask, live count) — the static-shape
+    form pjit pipelines compose (pair with groupby_padded's row_mask /
+    shuffle's ok mask); compact at the host boundary only when materializing.
+    """
+    m = _filter_mask(mask)
+    n = table.num_rows
+    order = nonzero_indices(m, count=n)
+    count = jnp.sum(m.astype(jnp.int32))
+    live = jnp.arange(n, dtype=jnp.int32) < count
+    return gather_table(table, order, indices_valid=live), live, count
 
 
 def sort_table(table: Table, keys: list[SortKey]) -> Table:

@@ -185,31 +185,47 @@ def substring(col: Column, start: int, length: int | None = None) -> Column:
     return from_padded_bytes(out, out_len, _prop_valid(col))
 
 
-def concat(*cols: Column) -> Column:
-    """Spark ``concat``: null if any input is null (host-compacted)."""
-    mats = []
-    total_valid = None
-    lens = []
-    for c in cols:
-        m, l = to_padded_bytes(c)
-        mats.append(np.asarray(m))
-        lens.append(np.asarray(l))
-        v = c.validity_numpy()
-        total_valid = v if total_valid is None else (total_valid & v)
+def concat_padded(mats, lens, valids=None):
+    """Jit-able Spark ``concat`` over padded byte matrices.
+
+    Each input row scatters at its running start offset into an output of
+    static width sum(w_k); dead lanes route to an out-of-bounds column and
+    drop.  Returns (u8[n, W] matrix, lengths, valid) — null if any input
+    row is null.
+    """
     n = mats[0].shape[0]
-    out_len = np.sum(lens, axis=0)
-    out = np.zeros((n, int(out_len.max()) if n else 0), np.uint8)
-    pos = np.zeros(n, np.int64)
-    rows = np.arange(n)
+    W = int(sum(m.shape[1] for m in mats))
+    out = jnp.zeros((n, W), jnp.uint8)
+    pos = jnp.zeros((n,), _I32)
+    rows = jnp.arange(n, dtype=_I32)[:, None]
     for m, l in zip(mats, lens):
         w = m.shape[1]
-        keep = np.arange(w)[None, :] < l[:, None]
-        tgt = pos[:, None] + np.arange(w)[None, :]
-        out[np.broadcast_to(rows[:, None], (n, w))[keep], tgt[keep]] = m[keep]
-        pos += l
-    has_null = total_valid is not None and not total_valid.all()
-    return from_padded_bytes(out, out_len,
-                             total_valid if has_null else None)
+        lane = jnp.arange(w, dtype=_I32)
+        tgt = pos[:, None] + lane[None, :]
+        tgt = jnp.where(lane[None, :] < l[:, None], tgt, W)  # dead -> drop
+        out = out.at[jnp.broadcast_to(rows, (n, w)), tgt].set(m, mode="drop")
+        pos = pos + l.astype(_I32)
+    valid = None
+    if valids is not None:
+        for v in valids:
+            if v is not None:
+                valid = v if valid is None else (valid & v)
+    return out, pos, valid
+
+
+def concat(*cols: Column) -> Column:
+    """Spark ``concat``: null if any input is null.  The scatter runs on
+    device (concat_padded); only the Arrow materialization is host-side."""
+    mats, lens, valids = [], [], []
+    for c in cols:
+        m, l = to_padded_bytes(c)
+        mats.append(m)
+        lens.append(l)
+        valids.append(c.validity)
+    out, out_len, valid = concat_padded(mats, lens, valids)
+    if valid is not None and bool(valid.all()):
+        valid = None
+    return from_padded_bytes(out, out_len, valid)
 
 
 # ---------------------------------------------------------------------------

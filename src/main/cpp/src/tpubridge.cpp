@@ -456,12 +456,14 @@ int tpub_export_table(tpub_ctx *ctx, uint64_t table, tpub_export *out) {
       if (end - p < 16) goto bad;
       uint64_t ooff = get<uint64_t>(p), olen = get<uint64_t>(p + 8);
       p += 16;
-      /* int32 offsets[nrows+1], and the final offset must stay inside the
-       * char buffer consumers slice with it */
+      /* int32 offsets[nrows+1]: every offset must be monotone and inside
+       * the char buffer consumers slice with it */
       if (!in_shm(ooff, olen) || olen / 4 < (uint64_t)c.nrows + 1) goto bad;
       const int32_t *offs = (const int32_t *)(block + ooff);
-      if (offs[c.nrows] < 0 || (uint64_t)offs[c.nrows] > (uint64_t)c.data_len)
+      if (offs[0] < 0 || (uint64_t)offs[c.nrows] > (uint64_t)c.data_len)
         goto bad;
+      for (int64_t r = 0; r < c.nrows; ++r)
+        if (offs[r] > offs[r + 1]) goto bad;
       c.offsets = offs;
     } else {
       c.offsets = nullptr;
@@ -523,7 +525,10 @@ int tpub_export_rows(tpub_ctx *ctx, uint64_t column, tpub_rows *out) {
   free_remote_shm(ctx, name);
 
   const int32_t *offs = (const int32_t *)(block + ooff);
-  if (offs[nrows] < 0 || (uint64_t)offs[nrows] > dlen) {
+  bool offs_ok = offs[0] >= 0 && (uint64_t)offs[nrows] <= dlen;
+  for (int64_t r = 0; offs_ok && r < nrows; ++r)
+    offs_ok = offs[r] <= offs[r + 1];
+  if (!offs_ok) {
     std::free(block);
     return ctx->fail("rows offsets exceed data buffer");
   }

@@ -271,3 +271,110 @@ def test_semi_join_hot_key_dedup():
     assert semi.num_rows == 5000
     anti = left_anti_join(left, right, ["k"])
     assert anti.num_rows == 0
+
+
+# -- device-side pipelines (no host round-trips in the traced path) ----------
+
+def test_inner_join_padded_matches_compact():
+    rng = np.random.default_rng(7)
+    lk = rng.integers(0, 20, 64).astype(np.int64)
+    rk = rng.integers(0, 20, 48).astype(np.int64)
+    left = Table([Column.from_numpy(lk),
+                  Column.from_numpy(np.arange(64, dtype=np.int64))],
+                 ["k", "lv"])
+    right = Table([Column.from_numpy(rk),
+                   Column.from_numpy(np.arange(48, dtype=np.int64) * 10)],
+                  ["k", "rv"])
+    from spark_rapids_jni_tpu.ops.join import inner_join_padded
+    want = inner_join(left, right, ["k"])
+    cap = 64 * 48
+    li, ri, live, npairs, overflow = inner_join_padded(
+        left, right, ["k"], ["k"], cap)
+    assert int(overflow) == 0
+    assert int(npairs) == want.num_rows
+    ln = np.asarray(li)[np.asarray(live)]
+    rn = np.asarray(ri)[np.asarray(live)]
+    got = sorted(zip(lk[ln].tolist(), (rk[rn] * 1).tolist(), ln.tolist()))
+    # every live pair joins equal keys
+    assert all(a == b for a, b, _ in got)
+    # pair multiset matches the compact join
+    got_pairs = sorted(zip(ln.tolist(), rn.tolist()))
+    want_pairs = sorted(
+        (int(l), int(r))
+        for l, r in zip(np.asarray(want["lv"].data),
+                        np.asarray(want["rv"].data) // 10))
+    assert got_pairs == want_pairs
+
+
+def test_inner_join_padded_overflow_counted():
+    left = Table([Column.from_pylist([1, 1, 1, 1], dt.INT64)], ["k"])
+    right = Table([Column.from_pylist([1, 1, 1, 1], dt.INT64)], ["k"])
+    from spark_rapids_jni_tpu.ops.join import inner_join_padded
+    li, ri, live, npairs, overflow = inner_join_padded(
+        left, right, ["k"], ["k"], 8)  # true expansion is 16
+    assert int(overflow) == 8
+    assert int(npairs) == 8 and int(np.asarray(live).sum()) == 8
+
+
+def test_filter_join_project_traces_end_to_end():
+    """The whole filter -> join -> project pipeline compiles as ONE XLA
+    program: any hidden numpy host round-trip would raise TracerArrayError
+    under jit."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.ops.join import inner_join_padded
+    from spark_rapids_jni_tpu.ops.selection import (
+        apply_boolean_mask_padded, gather_table)
+
+    n, m, cap = 32, 24, 256
+
+    @jax.jit
+    def pipeline(lk, lv, rk, rv):
+        left = Table([Column(dt.INT64, data=lk), Column(dt.INT64, data=lv)],
+                     ["k", "lv"])
+        right = Table([Column(dt.INT64, data=rk), Column(dt.INT64, data=rv)],
+                      ["k", "rv"])
+        fleft, flive, fcount = apply_boolean_mask_padded(left, lv > 10)
+        # padded filter leaves dead rows null -> they never match in the join
+        li, ri, jlive, npairs, overflow = inner_join_padded(
+            fleft, right, ["k"], ["k"], cap)
+        proj = gather_table(Table([fleft["lv"], fleft["k"]]), li,
+                            indices_valid=jlive)
+        rproj = gather_table(Table([right["rv"]]), ri, indices_valid=jlive)
+        return (proj.columns[0].data, rproj.columns[0].data, jlive, npairs,
+                overflow, fcount)
+
+    rng = np.random.default_rng(3)
+    lk = jnp.asarray(rng.integers(0, 8, n).astype(np.int64))
+    lv = jnp.asarray(rng.integers(0, 20, n).astype(np.int64))
+    rk = jnp.asarray(rng.integers(0, 8, m).astype(np.int64))
+    rv = jnp.asarray(rng.integers(0, 100, m).astype(np.int64))
+    lvd, rvd, jlive, npairs, overflow, fcount = pipeline(lk, lv, rk, rv)
+    assert int(overflow) == 0
+
+    # oracle: plain python
+    keep = [i for i in range(n) if int(lv[i]) > 10]
+    want = sorted((int(lv[i]), int(rv[j])) for i in keep for j in range(m)
+                  if int(lk[i]) == int(rk[j]))
+    livem = np.asarray(jlive)
+    got = sorted(zip(np.asarray(lvd)[livem].tolist(),
+                     np.asarray(rvd)[livem].tolist()))
+    assert got == want
+    assert int(npairs) == len(want)
+    assert int(fcount) == len(keep)
+
+
+def test_concat_padded_under_jit():
+    import jax
+    from spark_rapids_jni_tpu.ops.strings import concat_padded
+    from spark_rapids_jni_tpu.ops.strings_common import (
+        to_padded_bytes, from_padded_bytes)
+    a = Column.from_pylist(["ab", "", None, "xyz"])
+    b = Column.from_pylist(["1", "22", "333", None])
+    ma, la = to_padded_bytes(a)
+    mb, lb = to_padded_bytes(b)
+    out, lens, valid = jax.jit(concat_padded)(
+        (ma, mb), (la, lb), (a.validity, b.validity))
+    got = from_padded_bytes(np.asarray(out), np.asarray(lens),
+                            np.asarray(valid)).to_pylist()
+    assert got == ["ab1", "22", None, None]
