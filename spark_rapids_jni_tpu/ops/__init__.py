@@ -21,3 +21,4 @@ from .cast_strings import (  # noqa: F401
     cast_from_integer,
 )
 from .regex_rewrite import regex_matches  # noqa: F401
+from .dictionary import dictionary_encode, dictionary_decode  # noqa: F401

@@ -1,0 +1,65 @@
+"""Dictionary encoding: STRING column <-> (int32 codes, distinct-value dict).
+
+TPU-native analog of cudf's DICTIONARY32 columns (dtypes.py TypeId mirrors
+the id) — the form string *keys* take to cross the device mesh: codes are
+plain INT32 rows that shard/shuffle/aggregate like any fixed-width column,
+while the dictionary (small, distinct values only) replicates host-side.
+Spark's GpuShuffle does the same densification for high-cardinality string
+keys; Parquet stores most string columns dictionary-encoded already.
+
+Encoding is sort-based like the groupby (ops/aggregate.py): lexsort the
+order-preserving key words, segment at value boundaries, code = segment id.
+Codes are ordinal — c1 < c2 iff value1 < value2 — so ORDER BY on codes
+equals ORDER BY on the strings (a property cudf dictionaries share).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import Column
+from ..dtypes import INT32
+from .order import SortKey, encode_keys, rows_differ_from_prev
+from .selection import nonzero_indices, gather_column
+
+_I32 = jnp.int32
+
+
+def dictionary_encode(col: Column):
+    """(codes: INT32 Column, dictionary: Column of distinct non-null values).
+
+    Null rows get a null code (validity carries over); the dictionary holds
+    only non-null distinct values in ascending order.  Works for any
+    sortable column type; the headline use is STRING.
+    """
+    n = col.size
+    if n == 0:
+        return (Column(INT32, data=jnp.zeros((0,), _I32),
+                       validity=col.validity), gather_column(col, jnp.zeros((0,), _I32)))
+    words = encode_keys([SortKey(col)])  # null flag word first when nullable
+    order = jnp.lexsort(tuple(reversed(words)))
+    bounds = rows_differ_from_prev(words, order)
+    seg = jnp.cumsum(bounds.astype(_I32)) - 1
+    seg_of_row = jnp.zeros((n,), _I32).at[order].set(seg)
+
+    has_nulls = col.validity is not None and bool(
+        jnp.logical_not(col.validity).any())
+    if has_nulls:
+        # nulls sort first (asc default) as segment 0: shift codes down and
+        # exclude the null segment from the dictionary
+        codes = seg_of_row - 1
+        rep_positions = nonzero_indices(bounds)[1:]
+    else:
+        codes = seg_of_row
+        rep_positions = nonzero_indices(bounds)
+    reps = jnp.take(order, rep_positions).astype(_I32)
+    dictionary = gather_column(col, reps)
+    # dictionary rows are non-null by construction
+    dictionary = dictionary.with_validity(None)
+    return Column(INT32, data=codes, validity=col.validity), dictionary
+
+
+def dictionary_decode(codes: Column, dictionary: Column) -> Column:
+    """Inverse of dictionary_encode: gather dictionary rows by code."""
+    idx = jnp.asarray(codes.data, _I32)
+    return gather_column(dictionary, idx, indices_valid=codes.validity)

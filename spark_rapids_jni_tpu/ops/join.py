@@ -150,7 +150,7 @@ def inner_join(left: Table, right: Table, on_left, on_right=None,
 
 
 def inner_join_padded(left: Table, right: Table, on_left, on_right,
-                      capacity: int):
+                      capacity: int, left_live=None, right_live=None):
     """Fully jit-able inner join at a static pair capacity.
 
     Returns (li, ri, live, npairs, overflow): int32 pair indices padded to
@@ -161,16 +161,31 @@ def inner_join_padded(left: Table, right: Table, on_left, on_right,
     role the 2^31-byte batch split plays in the reference
     (row_conversion.cu:476-511): a tunable static bound with overflow
     *counted*, never silently dropped.
+
+    ``left_live``/``right_live``: row masks for padded inputs (e.g. rows
+    out of a shuffle exchange).  Dead rows never produce pairs, and their
+    hashes are replaced with per-row sentinels so a block of dead rows
+    can't explode the candidate expansion against itself.
     """
     on_right = on_right or on_left
     lk = _key_table(left, on_left)
     rk = _key_table(right, on_right)
     lh = xxhash64(lk).data
     rh = xxhash64(rk).data
+    if left_live is not None:
+        iota = jnp.arange(lh.shape[0], dtype=lh.dtype)
+        lh = jnp.where(left_live, lh, iota * 2 + 1)  # odd sentinels
+    if right_live is not None:
+        iota = jnp.arange(rh.shape[0], dtype=rh.dtype)
+        rh = jnp.where(right_live, rh, iota * 2)     # even sentinels
     r_order, lo, offsets, starts, expansion = _probe_ranges(lh, rh)
     li, ri, in_range = _expand_pairs(r_order, lo, offsets, starts,
                                      lh.shape[0], rh.shape[0], capacity)
     eq = in_range
+    if left_live is not None:
+        eq = eq & jnp.take(left_live, li)
+    if right_live is not None:
+        eq = eq & jnp.take(right_live, ri)
     for lc, rc in zip(lk.columns, rk.columns):
         eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
     # candidate pairs beyond capacity can't be equality-checked at static

@@ -9,4 +9,5 @@ first-class: row blobs ride ``jax.lax.all_to_all`` over the ICI mesh inside
 
 from .mesh import make_mesh, shard_table  # noqa: F401
 from .shuffle import shuffle_table_padded, partition_ids  # noqa: F401
-from .distributed import distributed_groupby  # noqa: F401
+from .distributed import distributed_groupby, distributed_join  # noqa: F401
+from .stringplane import explode_strings, reassemble_strings  # noqa: F401

@@ -1,15 +1,20 @@
 """Distributed query plans: shuffle-then-aggregate, shuffle-then-join.
 
-The classic Spark physical plan for GROUP BY — partial aggregation, hash
-exchange, final aggregation (what spark-rapids runs as GpuHashAggregate +
-GpuShuffleExchange) — expressed as ONE jittable XLA program over the mesh:
+The two classic Spark exchange plans, each expressed as ONE jittable XLA
+program over the mesh:
 
-    local groupby_padded  ->  row-blob all_to_all  ->  final groupby_padded
+- GROUP BY (GpuHashAggregate + GpuShuffleExchange):
+      local groupby_padded -> row-blob all_to_all -> final groupby_padded
+- equi-join (GpuShuffledHashJoin / SortMergeJoin, BASELINE configs[3]):
+      both sides hash-partition over all_to_all (co-partitioning)
+      -> shard-local padded sorted-probe join (ops.join.inner_join_padded)
 
-Everything stays in HBM; the exchange rides ICI.  Outputs are padded per
-shard (static shapes) with a live-row mask; ``distributed_groupby`` compacts
-at the host boundary, ``distributed_groupby_padded`` is the pure function for
-pjit pipelines (the dryrun/benchmark entry).
+Everything stays in HBM; the exchanges ride ICI.  Outputs are padded per
+shard (static shapes) with live-row masks; ``distributed_groupby`` /
+``distributed_join`` compact at the host boundary, the ``build_*``
+constructors return the pure shard_map programs for pjit pipelines (the
+dryrun/benchmark entries).  STRING columns cross the mesh in padded-bucket
+form (stringplane.explode_strings).
 """
 
 from __future__ import annotations
@@ -178,6 +183,211 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
         check_vma=False)
 
 
+# ---------------------------------------------------------------------------
+# distributed SortMergeJoin: co-partition by key hash, join locally per shard
+# ---------------------------------------------------------------------------
+
+def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
+                           rschema: tuple, rnames: tuple,
+                           on_left: tuple, on_right: tuple, how: str,
+                           lcap: int, rcap: int, jcap: int,
+                           axis: str = ROW_AXIS):
+    """Compile-once distributed equi-join for fixed schemas.
+
+    The physical plan Spark runs as GpuShuffledHashJoin/SortMergeJoin
+    (BASELINE configs[3]) as ONE jitted shard_map program: both sides
+    hash-partition by join key over ICI all_to_all (co-partitioning), then
+    each shard joins its partitions locally with the padded sorted-probe
+    join (ops.join.inner_join_padded).  Returns fn(ldatas, lmasks, rdatas,
+    rmasks) -> (lsel, rsel, live, rvalid, counts, overflows) where lsel/rsel
+    index the *exchanged* padded shard tables whose buffers are also
+    returned; the host wrapper assembles and compacts.
+    """
+    from ..ops.join import inner_join_padded
+    ndev = mesh.shape[axis]
+    llayout = fixed_width_layout(list(lschema))
+    rlayout = fixed_width_layout(list(rschema))
+
+    def exchange(layout, names, schema, datas, masks, key_names, cap):
+        tbl = Table([Column(dt_, data=d, validity=m)
+                     for dt_, d, m in zip(schema, datas, masks)], list(names))
+        keys = [tbl.column(k) for k in key_names]
+        dest = partition_ids(Table(keys), ndev)
+        rows = _to_row_words(layout, datas, masks)
+        send, ok, overflow = _bucket_scatter(rows, dest, None, ndev, cap)
+        recv = jax.lax.all_to_all(send, axis, 0, 0)
+        rok = jax.lax.all_to_all(ok, axis, 0, 0)
+        rows_in = recv.reshape(ndev * cap, rows.shape[1])
+        live_in = rok.reshape(ndev * cap)
+        d_in, m_in = _from_row_words(layout, rows_in)
+        tbl_in = Table([Column(dt_, data=d, validity=m)
+                        for dt_, d, m in zip(layout.schema, d_in, m_in)],
+                       list(names))
+        return tbl_in, live_in, overflow
+
+    def shard_fn(ldatas, lmasks, rdatas, rmasks):
+        ltbl, llive, lovf = exchange(llayout, lnames, lschema, ldatas,
+                                     lmasks, on_left, lcap)
+        rtbl, rlive, rovf = exchange(rlayout, rnames, rschema, rdatas,
+                                     rmasks, on_right, rcap)
+        li, ri, jlive, npairs, jovf = inner_join_padded(
+            ltbl, rtbl, list(on_left), list(on_right), jcap,
+            left_live=llive, right_live=rlive)
+
+        if how in ("inner", "left"):
+            if how == "left":
+                nl = ndev * lcap
+                matched = jnp.zeros((nl,), jnp.bool_)
+                if jcap:
+                    matched = matched.at[li].max(jlive)
+                extra_live = llive & jnp.logical_not(matched)
+                li = jnp.concatenate(
+                    [li, jnp.arange(nl, dtype=jnp.int32)])
+                ri = jnp.concatenate(
+                    [ri, jnp.zeros((nl,), jnp.int32)])
+                rvalid = jnp.concatenate(
+                    [jlive, jnp.zeros((nl,), jnp.bool_)])
+                live = jnp.concatenate([jlive, extra_live])
+            else:
+                rvalid = jlive
+                live = jlive
+            lsel = tuple(jnp.take(c.data, li, axis=0) for c in ltbl.columns)
+            lselv = tuple(jnp.take(c.valid_mask(), li) for c in ltbl.columns)
+            rsel = tuple(jnp.take(c.data, ri, axis=0) for c in rtbl.columns)
+            rselv = tuple(jnp.take(c.valid_mask(), ri) & rvalid
+                          for c in rtbl.columns)
+            nrows = jnp.sum(live.astype(jnp.int32))
+            return (lsel, lselv, rsel, rselv, live, jnp.reshape(nrows, (1,)),
+                    jax.lax.psum(lovf + rovf, axis),
+                    jax.lax.psum(jovf, axis))
+
+        # semi / anti: left rows with (no) matching key on the co-partition
+        nl = ndev * lcap
+        matched = jnp.zeros((nl,), jnp.bool_)
+        if jcap:
+            matched = matched.at[li].max(jlive)
+        keep = llive & (matched if how == "semi" else jnp.logical_not(matched))
+        lsel = tuple(c.data for c in ltbl.columns)
+        lselv = tuple(c.valid_mask() for c in ltbl.columns)
+        nrows = jnp.sum(keep.astype(jnp.int32))
+        return (lsel, lselv, (), (), keep, jnp.reshape(nrows, (1,)),
+                jax.lax.psum(lovf + rovf, axis), jax.lax.psum(jovf, axis))
+
+    spec = P(axis)
+    return shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec, spec, spec, P(), P()),
+        check_vma=False)
+
+
+def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
+                     on_right=None, how: str = "inner",
+                     capacity: int | None = None,
+                     join_capacity: int | None = None,
+                     suffixes=("", "_r"), axis: str = ROW_AXIS) -> Table:
+    """Distributed equi-join (inner/left/semi/anti); compacts to a host Table.
+
+    Both sides are hash-partitioned on the join keys over the mesh, then
+    joined shard-locally — the 8-chip shuffle + SortMergeJoin plan of
+    BASELINE configs[3].  STRING columns travel in padded-bucket form.
+    ``capacity`` bounds rows received per (source, dest) pair per side;
+    ``join_capacity`` bounds candidate pairs per shard.  Overflow raises
+    with the counts, never silently drops.
+    """
+    from .mesh import pad_to_multiple, shard_table
+    from .stringplane import explode_strings, reassemble_strings
+    on_right = list(on_right or on_left)
+    on_left = list(on_left)
+    ndev = mesh.shape[axis]
+
+    def prep(t, keys):
+        plan = None
+        if any(c.dtype.is_string for c in t.columns):
+            t, plan = explode_strings(t)
+            keys = plan.exploded_keys(keys)
+        if t.num_rows % ndev:
+            t, _ = pad_to_multiple(t, ndev)
+            # padded rows are all-null: null keys never match (SQL equi-join)
+        t = shard_table(t, mesh, axis)
+        return t, keys, plan
+
+    lt, lkeys, lplan = prep(left, on_left)
+    rt, rkeys, rplan = prep(right, on_right)
+    auto_cap = capacity is None
+    auto_jcap = join_capacity is None
+    if auto_cap:
+        capacity = max(lt.num_rows, rt.num_rows) // ndev
+    if auto_jcap:
+        join_capacity = 2 * ndev * capacity
+
+    lnames = tuple(lt.names or [f"l{i}" for i in range(lt.num_columns)])
+    rnames = tuple(rt.names or [f"r{i}" for i in range(rt.num_columns)])
+    largs = (tuple(c.data for c in lt.columns),
+             tuple(c.validity for c in lt.columns))
+    rargs = (tuple(c.data for c in rt.columns),
+             tuple(c.validity for c in rt.columns))
+    # Join cardinality is data-dependent; the counted overflows say exactly
+    # how much was missing, so auto-sized capacities retry right-sized
+    # (explicitly passed capacities are contracts and raise instead).
+    for _attempt in range(8):
+        fn = build_distributed_join(
+            mesh, tuple(lt.dtypes()), lnames, tuple(rt.dtypes()), rnames,
+            tuple(lkeys), tuple(rkeys), how, capacity, capacity,
+            join_capacity, axis)
+        (lsel, lselv, rsel, rselv, live, _n, xovf, jovf) = jax.jit(fn)(
+            *largs, *rargs)
+        if int(xovf) > 0:
+            if not auto_cap:
+                raise RuntimeError(
+                    f"distributed_join exchange overflow ({int(xovf)} rows); "
+                    f"rerun with larger capacity (got {capacity})")
+            capacity = 2 * capacity + (int(xovf) + ndev - 1) // ndev
+            if auto_jcap:
+                join_capacity = 2 * ndev * capacity
+            continue
+        if int(jovf) > 0:
+            if not auto_jcap:
+                raise RuntimeError(
+                    f"distributed_join pair overflow ({int(jovf)} candidate "
+                    f"pairs); rerun with larger join_capacity "
+                    f"(got {join_capacity})")
+            join_capacity = join_capacity + int(jovf) + 63 & ~63
+            continue
+        break
+    else:
+        raise RuntimeError("distributed_join failed to size its exchange")
+
+    live_np = np.asarray(live)
+    def compact(specs, valids, schema, names):
+        cols = []
+        for dt_, d, v in zip(schema, specs, valids):
+            dn = np.asarray(d)[live_np]
+            vn = np.asarray(v)[live_np]
+            cols.append(Column(dt_, data=jnp.asarray(dn),
+                               validity=None if vn.all() else jnp.asarray(vn)))
+        return Table(cols, list(names))
+
+    ltab = compact(lsel, lselv, lt.dtypes(), lnames)
+    if lplan is not None:
+        ltab = reassemble_strings(ltab, lplan)
+    if how in ("semi", "anti"):
+        return ltab
+    rtab = compact(rsel, rselv, rt.dtypes(), rnames)
+    if rplan is not None:
+        rtab = reassemble_strings(rtab, rplan)
+    # drop right key columns; suffix collisions (cudf/Spark projection shape)
+    keep = [i for i, nm in enumerate(rtab.names) if nm not in on_right]
+    lout_names = list(ltab.names)
+    out_cols = list(ltab.columns)
+    out_names = lout_names[:]
+    for i in keep:
+        nm = rtab.names[i]
+        out_cols.append(rtab.columns[i])
+        out_names.append(nm + (suffixes[1] if nm in lout_names else ""))
+    return Table(out_cols, out_names)
+
+
 def agg_out_dtype(col_dtype: DType, op: str) -> DType:
     """Result dtype of an aggregation (mirrors ops.aggregate._agg_column)."""
     if op in ("count", "count_all"):
@@ -202,14 +412,42 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
     Non-mesh-divisible tables are padded internally with masked null rows.
     Callers who pre-padded with ``pad_to_multiple`` must pass the original
     row count as ``n_valid_rows`` so padding rows don't aggregate as data.
+
+    STRING columns (keys or counted values) ride the mesh in padded-bucket
+    form (stringplane.explode_strings): exploded before sharding, grouped as
+    (length, byte-word) multi-keys, reassembled on the way out.
     """
     from .mesh import pad_to_multiple, shard_table
     ndev = mesh.shape[axis]
+
+    orig_keys = list(key_names)
+    orig_aggs = list(aggs)
+    plan = None
+    if any(c.dtype.is_string for c in table.columns):
+        from .stringplane import explode_strings, reassemble_strings, \
+            StringPlan
+        table, plan = explode_strings(table)
+        spec_of = dict(zip(plan.names, plan.specs))
+        key_names = plan.exploded_keys(orig_keys)
+        aggs = []
+        for ref, op in orig_aggs:
+            if spec_of.get(ref, ("fixed",))[0] == "string":
+                if op not in ("count", "count_all"):
+                    raise TypeError(
+                        "string value aggregation not supported; "
+                        "dictionary-encode first (ops.dictionary)")
+                aggs.append((f"{ref}#len", op))  # same validity as the string
+            else:
+                aggs.append((ref, op))
     if table.num_rows % ndev:
         if n_valid_rows is not None:
             raise ValueError("table rows not mesh-divisible; pad first or "
                              "let distributed_groupby pad (omit n_valid_rows)")
         table, n_valid_rows = pad_to_multiple(table, ndev)
+        table = shard_table(table, mesh, axis)
+    elif plan is not None:
+        # strings couldn't shard before explosion; place the exploded
+        # fixed-width buffers on the mesh now
         table = shard_table(table, mesh, axis)
     if capacity is None:
         capacity = table.num_rows // ndev
@@ -236,7 +474,8 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
     agg_dtypes = [agg_out_dtype(table.column(ref).dtype, op)
                   for ref, op in aggs]
     cols = []
-    names = list(key_names) + [f"{op}_{ref}" for ref, op in aggs]
+    agg_out_names = [f"{op}_{ref}" for ref, op in orig_aggs]
+    names = list(key_names) + agg_out_names
     for dtype, data, valid in zip(
             key_dtypes + agg_dtypes,
             list(key_data) + list(agg_data),
@@ -245,4 +484,11 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
         v = np.asarray(valid)[live_np]
         cols.append(Column(dtype, data=jnp.asarray(d),
                            validity=None if v.all() else jnp.asarray(v)))
-    return Table(cols, names)
+    result = Table(cols, names)
+    if plan is not None:
+        # fold exploded key columns back into strings
+        out_specs = tuple([spec_of[k] for k in orig_keys]
+                          + [("fixed",)] * len(orig_aggs))
+        out_plan = StringPlan(tuple(orig_keys + agg_out_names), out_specs)
+        result = reassemble_strings(result, out_plan)
+    return result

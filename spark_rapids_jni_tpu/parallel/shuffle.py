@@ -100,13 +100,29 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
 def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                          capacity: int | None = None,
                          axis: str = ROW_AXIS):
-    """Shuffle a row-sharded fixed-width table by key hash.
+    """Shuffle a row-sharded table by key hash.
 
     Returns (padded Table [ndev * ndev * capacity global rows], row mask
     Column-less bool array, overflow scalar).  Rows land on the partition
     owning pmod(murmur3(keys), ndev); padding rows have mask False.
+
+    STRING columns (keys or payloads) cross the exchange in padded-bucket
+    form (stringplane): exploded to fixed-width, shuffled inside the row
+    blobs, reassembled on the way out.  NOTE: string-key partitioning
+    hashes the exploded (length, words) representation — consistent across
+    the mesh, but not Spark's UTF8String murmur3; use fixed-width or
+    dictionary codes when wire-level Spark partition parity is required.
     """
     from ..ops.row_conversion import fixed_width_layout
+    plan = None
+    if any(c.dtype.is_string for c in table.columns):
+        from .stringplane import explode_strings, reassemble_strings
+        names0 = table.names or [f"c{i}" for i in range(table.num_columns)]
+        keys = [k if isinstance(k, str) else names0[int(k)] for k in keys]
+        table, plan = explode_strings(table)
+        keys = plan.exploded_keys(keys)
+        from .mesh import shard_table
+        table = shard_table(table, mesh, axis)  # strings couldn't shard before
     layout = fixed_width_layout(table.dtypes())
     ndev = mesh.shape[axis]
     shard_rows = table.num_rows // ndev
@@ -124,4 +140,8 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
     datas_out, masks_out = _from_row_words(layout, rows)
     cols = [Column(dt, data=d, validity=m)
             for dt, d, m in zip(layout.schema, datas_out, masks_out)]
-    return Table(cols, table.names), ok, overflow
+    out = Table(cols, table.names)
+    if plan is not None:
+        from .stringplane import reassemble_strings
+        out = reassemble_strings(out, plan)
+    return out, ok, overflow

@@ -1,0 +1,135 @@
+"""Strings in the distributed data plane: padded-bucket explosion.
+
+XLA collectives want static shapes; Arrow STRING columns (char buffer +
+n+1 offsets) have neither a per-row width nor a row-shardable layout.  The
+padded-bucket design (SURVEY.md §7 hard part #2): before a table enters the
+mesh, every STRING column *explodes* into fixed-width columns —
+
+    s  ->  s#len : INT32   (byte length, carries the validity)
+           s#w0.. : UINT32 (the padded byte matrix, 4 bytes per word,
+                            zero beyond the row's length)
+
+— which shard, ride row blobs through all_to_all, group, and join like any
+other fixed-width columns.  Zero padding + the length column make
+multi-key equality over (len, words...) exactly string equality, so a
+GROUP BY or join on an exploded string key needs no special casing
+anywhere downstream.  ``reassemble`` inverts the transform at the host
+boundary.
+
+The bucket width is the global max length rounded to a power-of-two
+(strings_common.pad_width_bucket), fixed at explode time — every shard
+compiles one program regardless of local maxima.  The alternative encoding
+for high-cardinality keys is ops/dictionary.dictionary_encode (INT32 codes
++ replicated dictionary); both coexist: dictionaries when values repeat,
+padded buckets when payload bytes must physically move.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Column, Table
+from ..dtypes import INT32, UINT32
+from ..ops.strings_common import to_padded_bytes, from_padded_bytes
+
+LEN_SUFFIX = "#len"
+WORD_SUFFIX = "#w"
+
+
+@dataclass(frozen=True)
+class StringPlan:
+    """Static recipe mapping original columns <-> exploded fixed columns."""
+
+    names: tuple  # original column names
+    specs: tuple  # per column: ("fixed",) | ("string", nwords)
+
+    @property
+    def has_strings(self) -> bool:
+        return any(s[0] == "string" for s in self.specs)
+
+    def exploded_names(self) -> list:
+        out = []
+        for nm, spec in zip(self.names, self.specs):
+            if spec[0] == "fixed":
+                out.append(nm)
+            else:
+                out.append(f"{nm}{LEN_SUFFIX}")
+                out.extend(f"{nm}{WORD_SUFFIX}{i}" for i in range(spec[1]))
+        return out
+
+    def exploded_keys(self, key_names) -> list:
+        """Map key column names to their exploded column names."""
+        spec_of = dict(zip(self.names, self.specs))
+        out = []
+        for k in key_names:
+            spec = spec_of[k]
+            if spec[0] == "fixed":
+                out.append(k)
+            else:
+                out.append(f"{k}{LEN_SUFFIX}")
+                out.extend(f"{k}{WORD_SUFFIX}{i}" for i in range(spec[1]))
+        return out
+
+
+def explode_strings(table: Table) -> tuple[Table, StringPlan]:
+    """Replace every STRING column with its fixed-width padded-bucket form.
+
+    Host-boundary op (the bucket width is a global data-dependent static);
+    everything downstream of it is jit-able.
+    """
+    names = tuple(table.names or [f"c{i}" for i in range(table.num_columns)])
+    cols, out_names, specs = [], [], []
+    for nm, c in zip(names, table.columns):
+        if not c.dtype.is_string:
+            cols.append(c)
+            out_names.append(nm)
+            specs.append(("fixed",))
+            continue
+        mat, lengths = to_padded_bytes(c)
+        n, w = mat.shape
+        nwords = max((w + 3) // 4, 1)
+        if w < nwords * 4:
+            mat = jnp.pad(mat, ((0, 0), (0, nwords * 4 - w)))
+        # null rows must not carry stray bytes into group/join equality
+        if c.validity is not None:
+            mat = jnp.where(c.validity[:, None], mat, jnp.uint8(0))
+            lengths = jnp.where(c.validity, lengths, 0)
+        words = jax.lax.bitcast_convert_type(
+            mat.reshape(n, nwords, 4), jnp.uint32)  # (n, nwords) LE
+        cols.append(Column(INT32, data=lengths.astype(jnp.int32),
+                           validity=c.validity))
+        out_names.append(f"{nm}{LEN_SUFFIX}")
+        for i in range(nwords):
+            cols.append(Column(UINT32, data=words[:, i], validity=c.validity))
+            out_names.append(f"{nm}{WORD_SUFFIX}{i}")
+        specs.append(("string", nwords))
+    return Table(cols, out_names), StringPlan(names, tuple(specs))
+
+
+def reassemble_strings(table: Table, plan: StringPlan) -> Table:
+    """Invert explode_strings (host boundary: Arrow re-materialization)."""
+    import numpy as np
+    cols, idx = [], 0
+    for nm, spec in zip(plan.names, plan.specs):
+        if spec[0] == "fixed":
+            cols.append(table.columns[idx])
+            idx += 1
+            continue
+        nwords = spec[1]
+        len_col = table.columns[idx]
+        word_cols = table.columns[idx + 1:idx + 1 + nwords]
+        idx += 1 + nwords
+        words = jnp.stack([c.data for c in word_cols], axis=1)
+        mat = jax.lax.bitcast_convert_type(
+            words, jnp.uint8).reshape(words.shape[0], nwords * 4)
+        valid = len_col.validity
+        lengths = np.asarray(len_col.data)
+        if valid is not None:
+            lengths = np.where(np.asarray(valid), lengths, 0)
+        has_null = valid is not None and not bool(valid.all())
+        cols.append(from_padded_bytes(np.asarray(mat), lengths,
+                                      valid if has_null else None))
+    return Table(cols, list(plan.names))

@@ -16,6 +16,7 @@ from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.aggregate import groupby
 from spark_rapids_jni_tpu.ops.hash import murmur3_hash
 from spark_rapids_jni_tpu.parallel import (
+    distributed_join,
     make_mesh, shard_table, shuffle_table_padded, partition_ids,
     distributed_groupby)
 from spark_rapids_jni_tpu.parallel.mesh import pad_to_multiple
@@ -183,3 +184,147 @@ def test_float64_exact_through_shuffle(mesh):
     got = np.sort(np.asarray(out["d"].data)[okn].view(np.uint64))
     want = np.sort(vals.view(np.uint64))
     np.testing.assert_array_equal(got, want)  # bit-exact doubles through ICI
+
+
+# -- strings in the data plane (padded-bucket explosion) ---------------------
+
+def _string_table(n, seed=5):
+    rng = np.random.default_rng(seed)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "", "zeta"]
+    svals = [words[i] if i < len(words) - 1 else None
+             for i in rng.integers(0, len(words), n)]
+    return Table([
+        Column.from_pylist(svals),
+        Column.from_numpy(rng.integers(-100, 100, n).astype(np.int64)),
+        Column.from_pylist(
+            [words[i] for i in rng.integers(0, len(words) - 1, n)]),
+    ], ["s", "v", "p"]), svals
+
+
+def test_distributed_groupby_string_keys(mesh):
+    t, _ = _string_table(NDEV * 16)
+    got = distributed_groupby(t, mesh, ["s"],
+                              [("v", "sum"), ("v", "count"),
+                               ("p", "count")])
+    want = groupby(t, ["s"], [("v", "sum"), ("v", "count"), ("p", "count")])
+    gd = {r[0]: r[1:] for r in zip(*[c.to_pylist() for c in got.columns])}
+    wd = {r[0]: r[1:] for r in zip(*[c.to_pylist() for c in want.columns])}
+    assert gd == wd
+    assert got.columns[0].dtype.is_string
+
+
+def test_shuffle_string_payload_lossless(mesh):
+    t, svals = _string_table(NDEV * 8, seed=9)
+    out, ok, overflow = shuffle_table_padded(t, mesh, ["v"])
+    assert int(overflow) == 0
+    okm = np.asarray(ok)
+    assert int(okm.sum()) == t.num_rows
+    # every (s, v, p) row survives the exchange exactly once
+    got = sorted(zip(np.asarray(out["s"].validity_numpy())[okm].tolist(),
+                     [x for x, o in zip(out["s"].to_pylist(), okm) if o],
+                     [x for x, o in zip(out["v"].to_pylist(), okm) if o],
+                     [x for x, o in zip(out["p"].to_pylist(), okm) if o]),
+                 key=lambda r: (str(r[1]), r[2], r[3]))
+    want = sorted(zip([v is not None for v in svals], svals,
+                      t["v"].to_pylist(), t["p"].to_pylist()),
+                  key=lambda r: (str(r[1]), r[2], r[3]))
+    assert [g[1:] for g in got] == [w[1:] for w in want]
+
+
+def test_shuffle_string_key_placement(mesh):
+    """Rows with equal string keys land on the same partition."""
+    t, _ = _string_table(NDEV * 8, seed=11)
+    out, ok, overflow = shuffle_table_padded(t, mesh, ["s"])
+    assert int(overflow) == 0
+    okm = np.asarray(ok)
+    per = NDEV * (t.num_rows // NDEV)  # rows per dest shard in padded output
+    svals_out = out["s"].to_pylist()
+    part_of = {}
+    for i, (sv, o) in enumerate(zip(svals_out, okm)):
+        if not o:
+            continue
+        p = i // per
+        part_of.setdefault(sv, set()).add(p)
+    assert all(len(ps) == 1 for ps in part_of.values()), part_of
+
+
+# -- distributed join --------------------------------------------------------
+
+def _join_fixture(seed=21, nl=NDEV * 12, nr=NDEV * 10):
+    rng = np.random.default_rng(seed)
+    words = ["red", "green", "blue", "cyan", "black", "white"]
+    lk = rng.integers(0, 18, nl)
+    rk = rng.integers(0, 18, nr)
+    left = Table([
+        Column.from_numpy(lk.astype(np.int64)),
+        Column.from_numpy(np.arange(nl, dtype=np.int64)),
+        Column.from_pylist([words[i % len(words)] if i % 7 else None
+                            for i in range(nl)]),
+    ], ["k", "lv", "ls"])
+    right = Table([
+        Column.from_numpy(rk.astype(np.int64)),
+        Column.from_numpy((np.arange(nr, dtype=np.int64) + 1) * 100),
+        Column.from_pylist([words[(i + 3) % len(words)] for i in range(nr)]),
+    ], ["k", "rv", "rs"])
+    return left, right
+
+
+def _rows_set(t: Table):
+    return sorted(zip(*[map(str, c.to_pylist()) for c in t.columns]))
+
+
+def test_distributed_join_inner_matches_local(mesh):
+    from spark_rapids_jni_tpu.ops.join import inner_join
+    left, right = _join_fixture()
+    got = distributed_join(left, right, mesh, ["k"])
+    want = inner_join(left, right, ["k"])
+    assert sorted(got.names) == sorted(want.names)
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
+
+
+def test_distributed_join_left_matches_local(mesh):
+    from spark_rapids_jni_tpu.ops.join import left_join
+    left, right = _join_fixture(seed=33)
+    got = distributed_join(left, right, mesh, ["k"], how="left")
+    want = left_join(left, right, ["k"])
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
+
+
+def test_distributed_join_semi_anti(mesh):
+    from spark_rapids_jni_tpu.ops.join import left_semi_join, left_anti_join
+    left, right = _join_fixture(seed=40)
+    got_s = distributed_join(left, right, mesh, ["k"], how="semi")
+    got_a = distributed_join(left, right, mesh, ["k"], how="anti")
+    assert _rows_set(got_s) == _rows_set(left_semi_join(left, right, ["k"]))
+    assert _rows_set(got_a) == _rows_set(left_anti_join(left, right, ["k"]))
+    assert got_s.num_rows + got_a.num_rows == left.num_rows
+
+
+def test_distributed_join_string_keys(mesh):
+    from spark_rapids_jni_tpu.ops.join import inner_join
+    rng = np.random.default_rng(55)
+    words = ["alpha", "beta", "gamma", "delta", None]
+    nl, nr = NDEV * 8, NDEV * 6
+    left = Table([
+        Column.from_pylist([words[i] for i in rng.integers(0, 5, nl)]),
+        Column.from_numpy(np.arange(nl, dtype=np.int64)),
+    ], ["s", "lv"])
+    right = Table([
+        Column.from_pylist([words[i] for i in rng.integers(0, 5, nr)]),
+        Column.from_numpy(np.arange(nr, dtype=np.int64) * 2),
+    ], ["s", "rv"])
+    got = distributed_join(left, right, mesh, ["s"])
+    want = inner_join(left, right, ["s"])
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
+
+
+def test_distributed_join_overflow_raises(mesh):
+    left = Table([Column.from_pylist([1] * (NDEV * 4), dt.INT64),
+                  Column.from_pylist(list(range(NDEV * 4)), dt.INT64)],
+                 ["k", "v"])
+    right = Table([Column.from_pylist([1] * (NDEV * 4), dt.INT64)], ["k"])
+    with pytest.raises(RuntimeError, match="overflow"):
+        distributed_join(left, right, mesh, ["k"], join_capacity=8)

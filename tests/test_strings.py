@@ -3,7 +3,8 @@
 import numpy as np
 import pytest
 
-from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu import dtypes as dt
 from spark_rapids_jni_tpu.ops import strings as s
 from spark_rapids_jni_tpu.ops.regex_rewrite import rewrite, regex_matches
 
@@ -157,3 +158,45 @@ def test_concat_vectorized_matches():
     got = s.concat(a, b).to_pylist()
     want = [x + y for x, y in zip(a.to_pylist(), b.to_pylist())]
     assert got == want
+
+
+# -- dictionary encoding -----------------------------------------------------
+
+def test_dictionary_encode_roundtrip():
+    from spark_rapids_jni_tpu.ops.dictionary import (
+        dictionary_encode, dictionary_decode)
+    vals = ["b", "a", None, "b", "cc", "a", None, ""]
+    col = Column.from_pylist(vals)
+    codes, dictionary = dictionary_encode(col)
+    assert dictionary.to_pylist() == ["", "a", "b", "cc"]  # sorted distinct
+    assert codes.dtype == dt.INT32
+    # ordinal property: codes order == value order
+    got_codes = [None if v is None else int(c) for c, v in
+                 zip(np.asarray(codes.data), vals)]
+    assert got_codes[0] == got_codes[3]  # both "b"
+    assert dictionary_decode(codes, dictionary).to_pylist() == vals
+
+
+def test_dictionary_encode_no_nulls_ints():
+    from spark_rapids_jni_tpu.ops.dictionary import (
+        dictionary_encode, dictionary_decode)
+    col = Column.from_pylist([5, 3, 5, 5, 1], dt.INT64)
+    codes, dictionary = dictionary_encode(col)
+    assert dictionary.to_pylist() == [1, 3, 5]
+    assert np.asarray(codes.data).tolist() == [2, 1, 2, 2, 0]
+    assert dictionary_decode(codes, dictionary).to_pylist() == [5, 3, 5, 5, 1]
+
+
+def test_explode_reassemble_strings():
+    from spark_rapids_jni_tpu.parallel.stringplane import (
+        explode_strings, reassemble_strings)
+    t = Table([
+        Column.from_pylist(["hello", None, "", "world!!"]),
+        Column.from_pylist([1, 2, 3, 4], dt.INT64),
+    ], ["s", "x"])
+    ex, plan = explode_strings(t)
+    assert plan.has_strings
+    assert all(not c.dtype.is_string for c in ex.columns)
+    back = reassemble_strings(ex, plan)
+    assert back["s"].to_pylist() == ["hello", None, "", "world!!"]
+    assert back["x"].to_pylist() == [1, 2, 3, 4]
