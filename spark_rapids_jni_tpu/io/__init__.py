@@ -1,0 +1,16 @@
+"""Columnar file I/O: the libcudf-I/O role of the stack.
+
+The reference consumes libcudf's Parquet reader (built by
+build-libcudf.xml:37-50; the ChunkedParquet north-star op in BASELINE.md)
+through JNI.  Here the scan path is native to the engine: footer/metadata
+parsing and page decode on the host, decoded buffers handed to the device as
+jax arrays, with the chunked reader bounding device-memory per pass the same
+way the reference bounds row-conversion batches to 2^31 bytes
+(row_conversion.cu:476-511).
+"""
+
+from .parquet import (  # noqa: F401
+    ParquetChunkedReader,
+    ParquetFile,
+    read_parquet,
+)

@@ -1,0 +1,79 @@
+"""Snappy raw-block decompression (Parquet's default codec).
+
+Pure-Python decoder for the snappy *raw* format pyarrow/parquet-mr emit per
+page: a varint uncompressed length, then a tag stream of literals and
+back-references.  The byte-granular back-references are inherently
+sequential, so this is host code operating on page-sized buffers (~1 MiB)
+before the decoded columns are handed to the device — the same division of
+labor as the reference, whose nvcomp/snappy decode also happens before cudf
+column assembly (libcudf parquet reader role, build-libcudf.xml:37-50).
+
+Performance notes: literals and non-overlapping copies are slice copies
+into a preallocated bytearray; overlapping copies (run-length patterns) are
+materialized by pattern doubling, so even pathological RLE data costs
+O(n log n) slice ops, not O(n) python-level byte writes.
+"""
+
+from __future__ import annotations
+
+
+def _uvarint(buf, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def decompress(src: bytes) -> bytes:
+    """Decode one snappy raw block (the whole-page unit Parquet uses)."""
+    n, pos = _uvarint(src, 0)
+    dst = bytearray(n)
+    dpos = 0
+    slen = len(src)
+    while pos < slen:
+        tag = src[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                nbytes = length - 60
+                length = int.from_bytes(src[pos:pos + nbytes], "little") + 1
+                pos += nbytes
+            dst[dpos:dpos + length] = src[pos:pos + length]
+            pos += length
+            dpos += length
+            continue
+        if kind == 1:  # copy, 1-byte offset, 4..11 length
+            length = ((tag >> 2) & 0x7) + 4
+            offset = ((tag & 0xE0) << 3) | src[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(src[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(src[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > dpos:
+            raise ValueError("corrupt snappy stream: bad copy offset")
+        start = dpos - offset
+        if offset >= length:
+            dst[dpos:dpos + length] = dst[start:start + length]
+            dpos += length
+        else:
+            # overlapping copy: repeat the window by doubling
+            pattern = bytes(dst[start:dpos])
+            while len(pattern) < length:
+                pattern += pattern
+            dst[dpos:dpos + length] = pattern[:length]
+            dpos += length
+    if dpos != n:
+        raise ValueError(f"corrupt snappy stream: wrote {dpos}, header said {n}")
+    return bytes(dst)
