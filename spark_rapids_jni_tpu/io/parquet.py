@@ -24,6 +24,7 @@ Supported surface (flat schemas — the Spark-SQL scan shape):
 
 from __future__ import annotations
 
+import mmap
 import os
 from dataclasses import dataclass, field
 
@@ -60,16 +61,7 @@ _PLAIN_NP = {
 }
 
 
-def _uvarint(buf, pos):
-    result = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
+_uvarint = snappy._uvarint  # one LEB128 decoder for the whole io package
 
 
 def _decompress(page: bytes, codec: int, uncompressed_size: int) -> bytes:
@@ -371,9 +363,14 @@ def _gather_dict(schema: ColumnSchema, dict_vals, idx: np.ndarray):
         chars, lens = dict_vals
         offs = np.zeros(len(lens) + 1, np.int64)
         np.cumsum(lens, out=offs[1:])
-        pieces = memoryview(chars.tobytes())
-        sel = b"".join(pieces[offs[i]:offs[i + 1]] for i in idx)
-        return np.frombuffer(sel, np.uint8), lens[idx]
+        # vectorized string gather: out[i] spans chars[offs[idx[i]] : +len]
+        sel_lens = lens[idx].astype(np.int64)
+        total = int(sel_lens.sum())
+        out_starts = np.concatenate(([0], np.cumsum(sel_lens)[:-1]))
+        pos = (np.arange(total, dtype=np.int64)
+               - np.repeat(out_starts, sel_lens)
+               + np.repeat(offs[idx], sel_lens))
+        return chars[pos], lens[idx]
     return dict_vals[idx]
 
 
@@ -499,8 +496,6 @@ class _ChunkDecoder:
                                  "use a smaller row-group size")
             return _HostColumn(s, None, chars, offsets.astype(np.int32), valid)
         storage = s.dtype.storage
-        if s.dtype.id == dt.TypeId.FLOAT64:
-            storage = np.dtype(np.float64)
         dense = np.zeros(nrows, storage)
         nn = np.concatenate([np.asarray(v, storage) for v in vals]) if vals \
             else np.zeros(0, storage)
@@ -520,8 +515,10 @@ class ParquetFile:
 
     def __init__(self, path: str | os.PathLike):
         self.path = os.fspath(path)
+        # mmap, not read(): host memory stays proportional to the pages a
+        # pass actually touches, which is what ParquetChunkedReader promises
         with open(self.path, "rb") as f:
-            buf = f.read()
+            buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         if buf[:4] != _MAGIC or buf[-4:] != _MAGIC:
             raise ValueError(f"{self.path}: not a parquet file")
         flen = int.from_bytes(buf[-8:-4], "little")
@@ -559,7 +556,12 @@ class ParquetFile:
         hi = st.get(5, st.get(1))
         if lo is None or hi is None or ck.schema.physical not in _PLAIN_NP:
             return None
+        if ck.schema.dtype.is_decimal:
+            # stats carry the unscaled integer; predicates are user-space
+            return None
         npdt = _PLAIN_NP[ck.schema.physical]
+        if ck.schema.dtype.storage.kind == "u":
+            npdt = np.dtype(f"<u{npdt.itemsize}")
         return (np.frombuffer(lo, npdt, 1)[0].item(),
                 np.frombuffer(hi, npdt, 1)[0].item(),
                 st.get(3))
@@ -572,6 +574,16 @@ class ParquetFile:
     def read(self, columns=None) -> Table:
         hosts = [self._decode_group(gi, columns)
                  for gi in range(self.num_row_groups)]
+        if not hosts:  # valid file, zero row groups (empty partition)
+            empty = [_HostColumn(
+                self.schema[i], None,
+                np.zeros(0, np.uint8), np.zeros(1, np.int32),
+                None) if self.schema[i].dtype.is_string else _HostColumn(
+                self.schema[i], np.zeros(0, self.schema[i].dtype.storage),
+                None, None, None)
+                for i in self._column_indices(columns)]
+            return Table([h.to_column() for h in empty],
+                         [h.schema.name for h in empty])
         if len(hosts) == 1:
             return Table([h.to_column() for h in hosts[0]],
                          [h.schema.name for h in hosts[0]])

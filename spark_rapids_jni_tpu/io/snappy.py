@@ -45,6 +45,8 @@ def decompress(src: bytes) -> bytes:
                 nbytes = length - 60
                 length = int.from_bytes(src[pos:pos + nbytes], "little") + 1
                 pos += nbytes
+            if pos + length > slen:
+                raise ValueError("corrupt snappy stream: truncated literal")
             dst[dpos:dpos + length] = src[pos:pos + length]
             pos += length
             dpos += length

@@ -257,3 +257,38 @@ def _varint(n: int) -> bytes:
         out.append(b | (0x80 if n else 0))
         if not n:
             return bytes(out)
+
+
+class TestEdgeCases:
+    def test_unsigned_stats_prune_correctly(self, tmp_path):
+        """uint32 stats must decode unsigned or pruning drops live groups."""
+        p = tmp_path / "u.parquet"
+        vals = np.linspace(2_900_000_000, 3_200_000_000, 1000).astype(np.uint32)
+        pq.write_table(pa.table({"u": pa.array(vals, pa.uint32())}), p,
+                       row_group_size=100)
+        got = sum(t.num_rows for t in ParquetChunkedReader(
+            p, predicate=("u", 2_900_000_000, 3_200_000_000)))
+        assert got == 1000
+        st = ParquetFile(p).group_stats(0, "u")
+        assert st[0] >= 2_900_000_000
+
+    def test_decimal_stats_never_prune(self, tmp_path):
+        """Decimal stats are unscaled ints; pruning on them would be wrong."""
+        p = tmp_path / "d.parquet"
+        import decimal
+        vals = [decimal.Decimal("1.50"), decimal.Decimal("99.25")]
+        pq.write_table(
+            pa.table({"d": pa.array(vals, pa.decimal128(9, 2))}), p)
+        assert ParquetFile(p).group_stats(0, "d") is None
+
+    def test_zero_row_groups(self, tmp_path):
+        p = tmp_path / "e.parquet"
+        pq.write_table(pa.table({"a": pa.array([], pa.int64()),
+                                 "s": pa.array([], pa.string())}), p)
+        t = read_parquet(p)
+        assert t.num_rows == 0
+        assert tuple(t.names) == ("a", "s")
+
+    def test_truncated_snappy_literal_raises(self):
+        with pytest.raises(ValueError):
+            snappy_decompress(b"\x05\x10ab")  # says 5 bytes, carries 2

@@ -1,0 +1,155 @@
+"""First end-to-end query: scan -> filter -> join -> groupby vs pandas oracle.
+
+SURVEY.md §7 "minimum end-to-end slice": a q5-lite of NDS (TPC-DS query 5
+flavor — sales by store over a date range).  The reference reaches this
+through Spark + libcudf's parquet reader + its JNI ops; here the whole plan
+runs inside the engine: ParquetChunkedReader (row-group pruning via footer
+stats), left_semi_join against a filtered date dimension, per-chunk partial
+aggregation (the streaming pattern the chunked reader exists for —
+BASELINE.md ParquetChunked config), partial combine, a dimension join that
+carries STRING payloads, and a final STRING-key groupby.  pyarrow writes the
+files; pandas is the semantic oracle.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.columnar import Table
+from spark_rapids_jni_tpu.io import ParquetChunkedReader, read_parquet
+from spark_rapids_jni_tpu.ops.aggregate import groupby
+from spark_rapids_jni_tpu.ops.join import inner_join, left_semi_join
+from spark_rapids_jni_tpu.ops.selection import apply_boolean_mask
+
+N_SALES = 30_000
+DATE_LO, DATE_HI = 2_450_900, 2_451_100  # d_date_sk range kept by the filter
+
+
+@pytest.fixture(scope="module")
+def warehouse(tmp_path_factory):
+    """Write a tiny NDS-like warehouse: store_sales + date_dim + store."""
+    root = tmp_path_factory.mktemp("warehouse")
+    rng = np.random.default_rng(7)
+
+    date_sk = rng.integers(2_450_800, 2_451_200, N_SALES)
+    store_sk = rng.integers(1, 13, N_SALES)
+    price = np.round(rng.uniform(0.5, 300.0, N_SALES), 2)
+    profit = np.round(rng.uniform(-50.0, 120.0, N_SALES), 2)
+    price_null = rng.random(N_SALES) < 0.03
+    sales = pa.table({
+        "ss_sold_date_sk": pa.array(date_sk, pa.int64()),
+        "ss_store_sk": pa.array(store_sk, pa.int64()),
+        "ss_ext_sales_price": pa.array(
+            np.where(price_null, np.nan, price), pa.float64(),
+            mask=price_null),
+        "ss_net_profit": pa.array(profit, pa.float64()),
+    })
+    # many small row groups so footer-stats pruning + chunking both engage;
+    # sort so some groups fall wholly outside [DATE_LO, DATE_HI]
+    order = np.argsort(date_sk, kind="stable")
+    pq.write_table(sales.take(order), root / "store_sales.parquet",
+                   row_group_size=2_000)
+
+    dsk = np.arange(2_450_800, 2_451_200, dtype=np.int64)
+    dates = pa.table({
+        "d_date_sk": pa.array(dsk, pa.int64()),
+        "d_month_seq": pa.array((dsk - 2_450_800) // 30, pa.int64()),
+    })
+    pq.write_table(dates, root / "date_dim.parquet")
+
+    names = ["ese", "ose", "anti", "ation", "eing", "bar"]
+    stores = pa.table({
+        "s_store_sk": pa.array(np.arange(1, 13, dtype=np.int64)),
+        # two stores per name: the final string-key groupby really groups
+        "s_store_name": pa.array([names[i % 6] for i in range(12)]),
+    })
+    pq.write_table(stores, root / "store.parquet")
+    return root, sales.take(order).to_pandas(), dates.to_pandas(), \
+        stores.to_pandas()
+
+
+def oracle(sales_df, dates_df, stores_df):
+    d = dates_df[(dates_df.d_date_sk >= DATE_LO)
+                 & (dates_df.d_date_sk <= DATE_HI)]
+    f = sales_df[sales_df.ss_sold_date_sk.isin(d.d_date_sk)]
+    j = f.merge(stores_df, left_on="ss_store_sk", right_on="s_store_sk")
+    g = j.groupby("s_store_name").agg(
+        sales=("ss_ext_sales_price", "sum"),
+        profit=("ss_net_profit", "sum"),
+        n=("ss_ext_sales_price", "count"),
+    ).reset_index()
+    return {r.s_store_name: (r.sales, r.profit, int(r.n))
+            for r in g.itertuples()}
+
+
+def run_engine(root):
+    # dimension side: scan + filter on the device
+    dates = read_parquet(root / "date_dim.parquet")
+    dkeep = apply_boolean_mask(
+        dates, (dates["d_date_sk"].data >= DATE_LO)
+        & (dates["d_date_sk"].data <= DATE_HI))
+    stores = read_parquet(root / "store.parquet")
+
+    # fact side: chunked scan with footer-stats pruning, then per-chunk
+    # semi-join date filter + partial aggregation (streaming pattern)
+    partials = []
+    n_chunks = 0
+    for chunk in ParquetChunkedReader(
+            root / "store_sales.parquet", pass_read_limit=96_000,
+            predicate=("ss_sold_date_sk", DATE_LO, DATE_HI)):
+        n_chunks += 1
+        kept = left_semi_join(chunk, dkeep, ["ss_sold_date_sk"],
+                              ["d_date_sk"])
+        if kept.num_rows == 0:
+            continue
+        partials.append(groupby(
+            kept, ["ss_store_sk"],
+            [("ss_ext_sales_price", "sum"), ("ss_net_profit", "sum"),
+             ("ss_ext_sales_price", "count")],
+            names=["sales", "profit", "n"]))
+    assert n_chunks > 1, "chunked reader must emit multiple passes"
+
+    merged = Table.from_pydict({
+        name: sum((p[name].to_pylist() for p in partials), [])
+        for name in partials[0].names})
+    totals = groupby(merged, ["ss_store_sk"],
+                     [("sales", "sum"), ("profit", "sum"), ("n", "sum")],
+                     names=["sales", "profit", "n"])
+
+    joined = inner_join(totals, stores, ["ss_store_sk"], ["s_store_sk"])
+    result = groupby(joined, ["s_store_name"],
+                     [("sales", "sum"), ("profit", "sum"), ("n", "sum")],
+                     names=["sales", "profit", "n"])
+    return {nm: (s, p, int(n)) for nm, s, p, n in zip(
+        result["s_store_name"].to_pylist(), result["sales"].to_pylist(),
+        result["profit"].to_pylist(), result["n"].to_pylist())}
+
+
+def test_q5_lite_matches_pandas(warehouse):
+    root, sales_df, dates_df, stores_df = warehouse
+    want = oracle(sales_df, dates_df, stores_df)
+    got = run_engine(root)
+    assert set(got) == set(want)
+    for name in want:
+        ws, wp, wn = want[name]
+        gs, gp, gn = got[name]
+        assert gn == wn, name
+        assert gs == pytest.approx(ws, rel=1e-9), name
+        assert gp == pytest.approx(wp, rel=1e-9), name
+
+
+def test_row_group_pruning_engages(warehouse):
+    """The sorted fact file must have prunable row groups for the predicate."""
+    root, *_ = warehouse
+    from spark_rapids_jni_tpu.io import ParquetFile
+    f = ParquetFile(root / "store_sales.parquet")
+    pruned = 0
+    for gi in range(f.num_row_groups):
+        st = f.group_stats(gi, "ss_sold_date_sk")
+        assert st is not None
+        gmin, gmax, _ = st
+        if gmin > DATE_HI or gmax < DATE_LO:
+            pruned += 1
+    assert pruned >= 1
+    assert f.num_row_groups - pruned >= 2
