@@ -1,12 +1,18 @@
-"""Benchmark: RowConversion throughput on the device vs a CPU Arrow-style packer.
+"""Benchmarks over the BASELINE.md north-star configs.
 
-BASELINE.json configs[0] ("RowConversion round-trip ... CPU Arrow baseline").
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line.  Headline metric: RowConversion device throughput
+(BASELINE configs[0]); ``extras`` carries CastStrings, HashAggregate and
+Parquet-scan so the artifact records >=3 metrics per round.
 
-- device path: the jitted u32-row-word kernel (ops/row_conversion)
-- baseline: vectorized numpy packing of the same table into the identical
-  wire format (the honest CPU columnar->row cost an Arrow-based row writer
-  pays; all strided copies, no python loops)
+Timing methodology (tunneled TPU): a value fetch costs ~50-90 ms and
+``block_until_ready`` returns before execution, so every device metric runs
+K iterations inside one jitted ``fori_loop`` with a per-iteration salt
+(defeats loop-invariant hoisting), reduced to one scalar fetch.  Rates are
+fitted from two K values to cancel the fixed dispatch+fetch cost.  Where the
+loop must materialize full-size output each iteration (RowConversion), the
+carry xors in the output matrix — this *overstates* traffic by one
+read+write of the carry per iteration, so reported GB/s is a lower bound on
+the kernel's standalone rate.
 """
 
 import json
@@ -16,9 +22,33 @@ import time
 import numpy as np
 
 
-def build_host_table(n: int):
-    rng = np.random.default_rng(0)
-    cols = [
+def fit_per_iter(make_loop, args, k1=16, k2=64):
+    """min-of-3 wall times at two K values -> steady per-iteration seconds."""
+    import jax
+    ts = {}
+    for k in (k1, k2):
+        jf = jax.jit(make_loop(k))
+        int(jf(*args))  # compile + warm
+        best = min(_timed(jf, args) for _ in range(3))
+        ts[k] = best
+    per = (ts[k2] - ts[k1]) / (k2 - k1)
+    if per <= 0:  # tunnel jitter; fall back to the conservative bound
+        per = ts[k2] / k2
+    return per
+
+
+def _timed(jf, args):
+    t0 = time.perf_counter()
+    int(jf(*args))
+    return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# 1. RowConversion (headline, BASELINE configs[0])
+# ---------------------------------------------------------------------------
+
+def build_host_table(n, rng):
+    return [
         ("i64", rng.integers(-2**62, 2**62, n).astype(np.int64), None),
         ("f64", rng.standard_normal(n), rng.random(n) > 0.1),
         ("i32", rng.integers(-2**31, 2**31 - 1, n).astype(np.int32), None),
@@ -29,7 +59,6 @@ def build_host_table(n: int):
         ("bool", (rng.random(n) > 0.5), None),
         ("dec64", rng.integers(-10**15, 10**15, n).astype(np.int64), None),
     ]
-    return cols
 
 
 def numpy_pack(cols, layout):
@@ -46,84 +75,210 @@ def numpy_pack(cols, layout):
         bit = np.uint8(1 << (i % 8))
         if valid is None:
             vbytes[:, i // 8] |= bit
-        else:
-            vbytes[valid, i // 8] |= bit
+        else:  # full-vector or, not boolean fancy indexing (4x faster)
+            vbytes[:, i // 8] |= np.where(valid, bit, np.uint8(0))
     out[:, layout.validity_offset:layout.validity_offset
         + layout.num_validity_bytes] = vbytes
     return out
 
 
-def main():
-    import spark_rapids_jni_tpu  # x64 on
+def bench_row_conversion(n=2_000_000):
     import jax
     import jax.numpy as jnp
     from spark_rapids_jni_tpu import dtypes as dt
     from spark_rapids_jni_tpu.columnar import Column, Table
     from spark_rapids_jni_tpu.ops.row_conversion import (
-        fixed_width_layout, _to_rows_bytes)
+        fixed_width_layout, _to_rows_bytes, _to_rows_wire)
 
-    n = 2_000_000  # 4M+ exceeds the remote AOT compile helper's limits
-    host_cols = build_host_table(n)
+    rng = np.random.default_rng(0)
+    host_cols = build_host_table(n, rng)
     schema = [dt.INT64, dt.FLOAT64, dt.INT32, dt.FLOAT32, dt.INT16, dt.INT8,
               dt.BOOL8, dt.decimal64(-4)]
     layout = fixed_width_layout(schema)
-
-    table = Table([
-        Column.from_numpy(data, validity=valid, dtype=d)
-        for (name, data, valid), d in zip(host_cols, schema)
-    ])
+    table = Table([Column.from_numpy(data, validity=valid, dtype=d)
+                   for (name, data, valid), d in zip(host_cols, schema)])
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
+    nw = layout.row_size // 4
 
-    # Timing on the axon tunnel needs care (measured here):
-    #  - block_until_ready returns before execution; only a value fetch waits
-    #  - a fetch round-trip costs ~90 ms, dwarfing a single ~2 ms conversion
-    # So: chain K salted conversions inside one jitted fori_loop (the salt on
-    # an i32 column defeats result caching), reduce each to a u32 checksum,
-    # and fetch one scalar.  Aggregate bytes / wall time -> true device rate.
-    K = 32
+    def make_loop(K):
+        def loop(d, m, acc):
+            def body(i, acc):
+                di = d[:2] + (d[2] ^ i.astype(jnp.int32),) + d[3:]
+                return acc ^ _to_rows_wire(layout, di, m)
+            out = jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body, acc)
+            return out.sum(dtype=jnp.uint32)
+        return loop
 
-    def run(d, m):
-        def body(i, acc):
-            di = d[:2] + (d[2] ^ i, ) + d[3:]
-            return acc + _to_rows_bytes(layout, di, m).sum(dtype=jnp.uint32)
-        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body,
-                                 jnp.uint32(0))
-
-    fn = jax.jit(run)
-    int(fn(datas, masks))  # compile + warm
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        int(fn(datas, masks))
-        times.append(time.perf_counter() - t0)
-    dev_s = min(times)
-    nbytes = K * n * layout.row_size
-    dev_gbps = nbytes / dev_s / 1e9
+    acc0 = jnp.zeros((n * nw,), jnp.uint32)
+    per = fit_per_iter(make_loop, (datas, masks, acc0))
+    dev_gbps = n * layout.row_size / per / 1e9
 
     # CPU Arrow-style baseline (best of 3)
     cpu_s = min(
-        (lambda: (lambda t: (numpy_pack(host_cols, layout),
-                             time.perf_counter() - t))(time.perf_counter()))()[1]
+        (lambda t0: (numpy_pack(host_cols, layout),
+                     time.perf_counter() - t0))(time.perf_counter())[1]
         for _ in range(3))
-    cpu_gbps = nbytes / cpu_s / 1e9
+    cpu_gbps = n * layout.row_size / cpu_s / 1e9
 
-    # cross-check on a 100k-row slice: device bytes == numpy wire bytes
+    # wire-bytes cross-check on a 100k slice against the numpy oracle
     ncheck = 100_000
     check = jax.jit(lambda d, m: _to_rows_bytes(layout, d, m))
-    got = np.asarray(check(tuple(d[:ncheck] for d in datas),
-                           tuple(None if m is None else m[:ncheck]
-                                 for m in masks)))
+    got = np.asarray(check(
+        tuple(d[:ncheck] for d in datas),
+        tuple(None if m is None else m[:ncheck] for m in masks)))
     ref = numpy_pack([(nm, d0[:ncheck], None if v0 is None else v0[:ncheck])
                       for nm, d0, v0 in host_cols], layout).reshape(-1)
     ok = bool((got == ref).all())
+    return dev_gbps, cpu_gbps, ok
+
+
+# ---------------------------------------------------------------------------
+# 2. CastStrings: string -> int64 (north-star op)
+# ---------------------------------------------------------------------------
+
+def bench_cast_strings(n=2_000_000):
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.ops.cast_strings import _parse_number
+
+    rng = np.random.default_rng(1)
+    width = 18
+    digits = rng.integers(0, 10, (n, width)).astype(np.uint8) + ord("0")
+    mat = jnp.asarray(digits)
+    lengths = jnp.full((n,), width, jnp.int32)
+
+    def make_loop(K):
+        def loop(mat, lengths):
+            def body(i, acc):
+                m = mat.at[:, -1].set((48 + i % 10).astype(jnp.uint8))
+                p = _parse_number(m, lengths, True, False, False)
+                return acc + p["digits"].sum(dtype=jnp.uint64).astype(
+                    jnp.uint32) + p["syntax_ok"].sum(dtype=jnp.uint32)
+            return jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body,
+                                     jnp.uint32(0))
+        return loop
+
+    per = fit_per_iter(make_loop, (mat, lengths))
+    dev_mrows = n / per / 1e6
+
+    # CPU baseline: pandas vectorized string->int64 on the same strings
+    import pandas as pd
+    ser = pd.Series(digits.view(f"S{width}").ravel())
+    t0 = time.perf_counter()
+    ser.astype(np.int64)
+    cpu_mrows = n / (time.perf_counter() - t0) / 1e6
+    return dev_mrows, cpu_mrows
+
+
+# ---------------------------------------------------------------------------
+# 3. HashAggregate: groupby(sum, count) (BASELINE configs[2] shape, scaled)
+# ---------------------------------------------------------------------------
+
+def bench_hash_aggregate(n=2_000_000, nkeys=100_000):
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu import dtypes as dt
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.ops.aggregate import groupby_padded
+
+    rng = np.random.default_rng(2)
+    k = jnp.asarray(rng.integers(0, nkeys, n).astype(np.int64))
+    v = jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int64))
+
+    def make_loop(K):
+        def loop(k, v):
+            def body(i, acc):
+                tbl = Table([Column(dt.INT64, data=k ^ (i & 7)),
+                             Column(dt.INT64, data=v)], ["k", "v"])
+                _, aggs, ng = groupby_padded(
+                    tbl, ["k"], [("v", "sum"), ("v", "count")])
+                return acc + ng.astype(jnp.uint32) + \
+                    aggs[0].data.sum(dtype=jnp.int64).astype(jnp.uint32)
+            return jax.lax.fori_loop(jnp.int64(0), jnp.int64(K), body,
+                                     jnp.uint32(0))
+        return loop
+
+    per = fit_per_iter(make_loop, (k, v), k1=8, k2=32)
+    dev_mrows = n / per / 1e6
+
+    import pandas as pd
+    df = pd.DataFrame({"k": np.asarray(k), "v": np.asarray(v)})
+    t0 = time.perf_counter()
+    df.groupby("k").v.agg(["sum", "count"])
+    cpu_mrows = n / (time.perf_counter() - t0) / 1e6
+    return dev_mrows, cpu_mrows
+
+
+# ---------------------------------------------------------------------------
+# 4. Parquet scan (ParquetChunked north star)
+# ---------------------------------------------------------------------------
+
+def bench_parquet_scan(n=2_000_000):
+    import shutil, tempfile, os
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_jni_tpu.io import read_parquet
+
+    rng = np.random.default_rng(3)
+    tbl = pa.table({
+        "a": pa.array(rng.integers(0, 10**9, n).astype(np.int64)),
+        "b": pa.array(rng.standard_normal(n)),
+        "c": pa.array(rng.integers(0, 100, n).astype(np.int32)),
+    })
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "bench.parquet")
+    pq.write_table(tbl, path, compression="snappy", row_group_size=250_000)
+    nbytes = n * (8 + 8 + 4)
+    from spark_rapids_jni_tpu.io import ParquetFile
+
+    # host decode (the engine's own work; page decode + dict gather)
+    f = ParquetFile(path)
+    t0 = time.perf_counter()
+    for gi in range(f.num_row_groups):
+        f._decode_group(gi)
+    decode = nbytes / (time.perf_counter() - t0) / 1e6
+
+    # end-to-end into device columns; on tunneled devices this is bounded by
+    # the host->device link (~54 MB/s here), not the scan path
+    t0 = time.perf_counter()
+    out = read_parquet(path)
+    float(out.columns[0].data.sum())  # wait for device residency
+    e2e = nbytes / (time.perf_counter() - t0) / 1e6
+
+    t0 = time.perf_counter()
+    pq.read_table(path)
+    arrow = nbytes / (time.perf_counter() - t0) / 1e6
+    shutil.rmtree(d)
+    return decode, e2e, arrow
+
+
+def main():
+    import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
+
+    dev_gbps, cpu_gbps, ok = bench_row_conversion()
+    cast_dev, cast_cpu = bench_cast_strings()
+    agg_dev, agg_cpu = bench_hash_aggregate()
+    scan_decode, scan_e2e, scan_arrow = bench_parquet_scan()
 
     print(json.dumps({
-        "metric": "row_conversion_to_rows_GBps"
-                  + ("" if ok else "_MISMATCH"),
+        "metric": "row_conversion_to_rows_GBps" + ("" if ok else "_MISMATCH"),
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / cpu_gbps, 3),
+        "extras": {
+            "cast_strings_to_int64_Mrows_s": {
+                "value": round(cast_dev, 2),
+                "vs_cpu_pandas": round(cast_dev / cast_cpu, 2)},
+            "hash_aggregate_Mrows_s": {
+                "value": round(agg_dev, 2),
+                "vs_cpu_pandas": round(agg_dev / agg_cpu, 2)},
+            "parquet_scan_decode_MBps": {
+                "value": round(scan_decode, 1),
+                "vs_pyarrow": round(scan_decode / scan_arrow, 3)},
+            "parquet_scan_to_device_MBps": {
+                "value": round(scan_e2e, 1)},
+        },
     }))
 
 

@@ -1,4 +1,4 @@
-from .column import Column
+from .column import Column, PackedByteColumn
 from .table import Table
 
-__all__ = ["Column", "Table"]
+__all__ = ["Column", "PackedByteColumn", "Table"]

@@ -278,3 +278,34 @@ jax.tree_util.register_pytree_node(
     lambda c: c.tree_flatten(),
     Column.tree_unflatten,
 )
+
+
+class PackedByteColumn(Column):
+    """INT8 column whose device buffer is packed little-endian uint32 words.
+
+    The TPU analog of the reference's int64-coalesced access to byte blobs
+    (reference row_conversion.cu:84-108,278-300): byte-granular device
+    buffers would eat a ~2x relayout on TPU (see docs/PERF.md), so row-blob
+    children keep u32 words in HBM and materialize bytes only at host
+    boundaries, where ``np.view`` is a free reinterpretation.
+
+    ``size`` reports BYTES so the Arrow LIST invariant
+    ``offsets[-1] == child.size`` holds for blob parents.
+    """
+
+    __slots__ = ()
+
+    @property
+    def size(self) -> int:  # logical length in bytes, not words
+        return 0 if self.data is None else 4 * self.data.shape[0]
+
+    def bytes_numpy(self) -> np.ndarray:
+        """Host byte view of the packed words (free reinterpretation)."""
+        return np.asarray(self.data).view(np.uint8)
+
+
+jax.tree_util.register_pytree_node(
+    PackedByteColumn,
+    lambda c: c.tree_flatten(),
+    PackedByteColumn.tree_unflatten,
+)

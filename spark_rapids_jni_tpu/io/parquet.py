@@ -63,12 +63,25 @@ _PLAIN_NP = {
 
 _uvarint = snappy._uvarint  # one LEB128 decoder for the whole io package
 
+# Accelerated codec, when a native one is linked (the reference does the
+# same with nvcomp inside libcudf); io.snappy stays as the self-contained
+# fallback and keeps its own tests.
+try:
+    import pyarrow as _pa
+    _SNAPPY_NATIVE = _pa.Codec("snappy")
+except Exception:  # pragma: no cover - pyarrow is baked into this env
+    _SNAPPY_NATIVE = None
+
 
 def _decompress(page: bytes, codec: int, uncompressed_size: int) -> bytes:
     if codec == CODEC_UNCOMPRESSED:
         return page
     if codec == CODEC_SNAPPY:
-        out = snappy.decompress(page)
+        if _SNAPPY_NATIVE is not None:
+            out = _SNAPPY_NATIVE.decompress(
+                page, decompressed_size=uncompressed_size).to_pybytes()
+        else:
+            out = snappy.decompress(page)
         if len(out) != uncompressed_size:
             raise ValueError("snappy page size mismatch")
         return out
@@ -361,6 +374,8 @@ def _decode_plain(schema: ColumnSchema, buf: bytes, nvals: int):
 def _gather_dict(schema: ColumnSchema, dict_vals, idx: np.ndarray):
     if schema.physical == PT_BYTE_ARRAY:
         chars, lens = dict_vals
+        if idx.size == 0:  # all-null page: nothing to gather
+            return np.zeros(0, np.uint8), np.zeros(0, lens.dtype)
         offs = np.zeros(len(lens) + 1, np.int64)
         np.cumsum(lens, out=offs[1:])
         # vectorized string gather: out[i] spans chars[offs[idx[i]] : +len]

@@ -1,0 +1,108 @@
+"""VMEM-tiled Pallas kernels for the row-conversion hot path.
+
+TPU analog of the reference's staged shared-memory kernels
+(reference src/main/cpp/src/row_conversion.cu:75-108, 278-300): the CUDA
+version stages rows in dynamic shared memory so global-memory transactions
+are int64-coalesced; here a Pallas kernel stages plane blocks in VMEM and
+performs the 32-row-group interleave on-chip, so HBM sees only dense,
+full-lane reads and writes.
+
+Availability: Mosaic compilation is not available on every deployment (the
+remote-compile path of tunneled devices rejects Pallas kernels); callers must
+check ``available()`` and fall back to the pure-XLA wire path in
+``ops.row_conversion`` (concat + constant lane permutation).  The kernels are
+correctness-tested in interpreter mode on CPU either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_GROUP = 32  # rows per wire group, = row_conversion.WIRE_GROUP
+
+
+def _interleave_kernel(p_ref, o_ref):
+    """(nwords, B) plane block -> (B//32, 32*nwords) wire block in VMEM."""
+    b = p_ref.shape[1]
+    o_ref[:] = p_ref[:].T.reshape(b // _GROUP, _GROUP * p_ref.shape[0])
+
+
+def _deinterleave_kernel(w_ref, o_ref):
+    """(B//32, 32*nwords) wire block -> (nwords, B) plane block in VMEM."""
+    nw = o_ref.shape[0]
+    b = o_ref.shape[1]
+    o_ref[:] = w_ref[:].reshape(b, nw).T
+
+
+def _pallas_call(nwords: int, n: int, block_rows: int, forward: bool,
+                 interpret: bool):
+    from jax.experimental import pallas as pl
+
+    grid = (n // block_rows,)
+    plane_spec = pl.BlockSpec((nwords, block_rows), lambda r: (0, r))
+    wire_spec = pl.BlockSpec((block_rows // _GROUP, _GROUP * nwords),
+                             lambda r: (r, 0))
+    if forward:
+        in_specs, out_specs = [plane_spec], wire_spec
+        out_shape = jax.ShapeDtypeStruct((n // _GROUP, _GROUP * nwords),
+                                         jnp.uint32)
+        body = _interleave_kernel
+    else:
+        in_specs, out_specs = [wire_spec], plane_spec
+        out_shape = jax.ShapeDtypeStruct((nwords, n), jnp.uint32)
+        body = _deinterleave_kernel
+    return pl.pallas_call(body, grid=grid, in_specs=in_specs,
+                          out_specs=out_specs, out_shape=out_shape,
+                          interpret=interpret)
+
+
+def _pick_block_rows(n: int, nwords: int) -> int:
+    # VMEM budget ~ 2 blocks in flight * 2 (in+out) * 4B * nwords * block
+    target = max(_GROUP, (2 << 20) // max(nwords * 4, 1) // _GROUP * _GROUP)
+    b = min(n, target)
+    while n % b:
+        b -= _GROUP
+    return max(b, _GROUP)
+
+
+def interleave_planes(planes, *, interpret: bool = False) -> jnp.ndarray:
+    """Stack of word planes ``[u32[n]] * nwords`` -> wire ``u32[n*nwords]``.
+
+    Requires n % 32 == 0 (callers pad, like the 32-row batch alignment the
+    wire format already guarantees — reference row_conversion.cu:477-479).
+    """
+    nwords = len(planes)
+    n = planes[0].shape[0]
+    if n % _GROUP:
+        raise ValueError(f"n={n} not a multiple of {_GROUP}")
+    mat = jnp.stack(planes, axis=0)  # (nwords, n) — dense concat
+    block = _pick_block_rows(n, nwords)
+    out = _pallas_call(nwords, n, block, True, interpret)(mat)
+    return out.reshape(-1)
+
+
+def deinterleave_wire(wire: jnp.ndarray, nwords: int, *,
+                      interpret: bool = False) -> list[jnp.ndarray]:
+    """Wire ``u32[n*nwords]`` -> word planes ``[u32[n]] * nwords``."""
+    n = wire.shape[0] // nwords
+    if n % _GROUP:
+        raise ValueError(f"n={n} not a multiple of {_GROUP}")
+    block = _pick_block_rows(n, nwords)
+    mat = _pallas_call(nwords, n, block, False, interpret)(
+        wire.reshape(n // _GROUP, _GROUP * nwords))
+    return [mat[w] for w in range(nwords)]
+
+
+@functools.lru_cache(maxsize=1)
+def available() -> bool:
+    """Probe whether Mosaic can compile on this backend (cached)."""
+    try:
+        planes = [jnp.zeros((_GROUP,), jnp.uint32) for _ in range(2)]
+        np.asarray(interleave_planes(planes))
+        return True
+    except Exception:
+        return False

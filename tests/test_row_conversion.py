@@ -193,3 +193,15 @@ def test_jit_to_rows_traceable():
     datas = (jnp.arange(8, dtype=jnp.int64), fcol.data)
     out = _to_rows_bytes(lay, datas, (None, None))
     assert out.shape == (8 * lay.row_size,)
+
+
+def test_blob_child_list_invariant():
+    """offsets[-1] == child.size (bytes) even with the packed-u32 backing."""
+    import numpy as np
+    from spark_rapids_jni_tpu.columnar import PackedByteColumn
+    t = Table([Column.from_numpy(np.arange(100, dtype=np.int64))])
+    blob = convert_to_rows(t)[0]
+    child = blob.children[0]
+    assert isinstance(child, PackedByteColumn)
+    assert int(np.asarray(blob.offsets)[-1]) == child.size
+    assert child.bytes_numpy().size == child.size
