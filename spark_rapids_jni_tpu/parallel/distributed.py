@@ -33,7 +33,8 @@ from ..ops.aggregate import groupby_padded
 from ..ops.row_conversion import fixed_width_layout, _to_row_words, \
     _from_row_words
 from .mesh import ROW_AXIS
-from .shuffle import partition_ids, _bucket_scatter
+from .shuffle import (partition_ids, _bucket_scatter, cap_bucket,
+                      make_partition_counts, partition_counts)
 
 # (partial op emitted by the local pass, final re-aggregation op)
 _REAGG = {"sum": "sum", "count": "sum", "count_all": "sum",
@@ -73,6 +74,7 @@ def _padded_table(out_keys, out_aggs, key_names):
     return Table(cols, names)
 
 
+@functools.lru_cache(maxsize=64)
 def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
                               key_names: tuple, aggs: tuple,
                               capacity: int, axis: str = ROW_AXIS,
@@ -172,21 +174,22 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
 
     spec = P(axis)
     if masked:
-        return shard_map(
+        return jax.jit(shard_map(
             shard_fn, mesh=mesh, in_specs=(spec, spec, P()),
             out_specs=(spec, spec, spec, spec, spec, spec, P()),
-            check_vma=False)
-    return shard_map(
+            check_vma=False))
+    return jax.jit(shard_map(
         lambda datas, masks: shard_fn(datas, masks), mesh=mesh,
         in_specs=(spec, spec),
         out_specs=(spec, spec, spec, spec, spec, spec, P()),
-        check_vma=False)
+        check_vma=False))
 
 
 # ---------------------------------------------------------------------------
 # distributed SortMergeJoin: co-partition by key hash, join locally per shard
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=64)
 def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
                            rschema: tuple, rnames: tuple,
                            on_left: tuple, on_right: tuple, how: str,
@@ -317,9 +320,14 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
     auto_cap = capacity is None
     auto_jcap = join_capacity is None
     if auto_cap:
-        capacity = max(lt.num_rows, rt.num_rows) // ndev
+        # two-phase exchange: counts are exact for joins (no pre-agg dedup);
+        # each side sized independently (builder takes lcap/rcap)
+        lcap = cap_bucket(int(partition_counts(lt, mesh, lkeys, axis).max()))
+        rcap = cap_bucket(int(partition_counts(rt, mesh, rkeys, axis).max()))
+    else:
+        lcap = rcap = capacity
     if auto_jcap:
-        join_capacity = 2 * ndev * capacity
+        join_capacity = 2 * ndev * max(lcap, rcap)
 
     lnames = tuple(lt.names or [f"l{i}" for i in range(lt.num_columns)])
     rnames = tuple(rt.names or [f"r{i}" for i in range(rt.num_columns)])
@@ -333,18 +341,21 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
     for _attempt in range(8):
         fn = build_distributed_join(
             mesh, tuple(lt.dtypes()), lnames, tuple(rt.dtypes()), rnames,
-            tuple(lkeys), tuple(rkeys), how, capacity, capacity,
+            tuple(lkeys), tuple(rkeys), how, lcap, rcap,
             join_capacity, axis)
-        (lsel, lselv, rsel, rselv, live, _n, xovf, jovf) = jax.jit(fn)(
+        (lsel, lselv, rsel, rselv, live, _n, xovf, jovf) = fn(
             *largs, *rargs)
         if int(xovf) > 0:
+            # structurally unreachable with counts-based sizing; kept as a
+            # defense-in-depth invariant for explicitly passed capacities
             if not auto_cap:
                 raise RuntimeError(
                     f"distributed_join exchange overflow ({int(xovf)} rows); "
-                    f"rerun with larger capacity (got {capacity})")
-            capacity = 2 * capacity + (int(xovf) + ndev - 1) // ndev
+                    f"rerun with larger capacity (got {lcap}/{rcap})")
+            lcap = 2 * lcap + (int(xovf) + ndev - 1) // ndev
+            rcap = 2 * rcap + (int(xovf) + ndev - 1) // ndev
             if auto_jcap:
-                join_capacity = 2 * ndev * capacity
+                join_capacity = 2 * ndev * max(lcap, rcap)
             continue
         if int(jovf) > 0:
             if not auto_jcap:
@@ -450,7 +461,13 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
         # fixed-width buffers on the mesh now
         table = shard_table(table, mesh, axis)
     if capacity is None:
-        capacity = table.num_rows // ndev
+        # two-phase exchange: raw-row partition counts upper-bound the
+        # partial-group rows each shard sends (local agg only dedups)
+        counts = partition_counts(table, mesh, list(key_names), axis,
+                                  n_valid_rows=n_valid_rows)
+        shard_rows = table.num_rows // ndev
+        capacity = min(cap_bucket(int(counts.max())),
+                       cap_bucket(shard_rows))
     fn = build_distributed_groupby(
         mesh, tuple(table.dtypes()),
         tuple(table.names or [f"c{i}" for i in range(table.num_columns)]),
@@ -460,10 +477,10 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
     masks = tuple(c.validity for c in table.columns)
     if n_valid_rows is not None:
         (key_data, key_valid, agg_data, agg_valid, live, _ng,
-         overflow) = jax.jit(fn)(datas, masks, jnp.int64(n_valid_rows))
+         overflow) = fn(datas, masks, jnp.int64(n_valid_rows))
     else:
         (key_data, key_valid, agg_data, agg_valid, live, _ng,
-         overflow) = jax.jit(fn)(datas, masks)
+         overflow) = fn(datas, masks)
     if int(overflow) > 0:
         raise RuntimeError(
             f"shuffle capacity overflow ({int(overflow)} rows); rerun with "

@@ -237,7 +237,7 @@ def test_shuffle_string_key_placement(mesh):
     out, ok, overflow = shuffle_table_padded(t, mesh, ["s"])
     assert int(overflow) == 0
     okm = np.asarray(ok)
-    per = NDEV * (t.num_rows // NDEV)  # rows per dest shard in padded output
+    per = out.num_rows // NDEV  # rows per dest shard in padded output
     svals_out = out["s"].to_pylist()
     part_of = {}
     for i, (sv, o) in enumerate(zip(svals_out, okm)):
@@ -328,3 +328,79 @@ def test_distributed_join_overflow_raises(mesh):
     right = Table([Column.from_pylist([1] * (NDEV * 4), dt.INT64)], ["k"])
     with pytest.raises(RuntimeError, match="overflow"):
         distributed_join(left, right, mesh, ["k"], join_capacity=8)
+
+
+# ---------------------------------------------------------------------------
+# two-phase (counts-sized) exchange
+# ---------------------------------------------------------------------------
+
+def test_partition_counts_match_destinations(mesh):
+    t = make_table(NDEV * 32, nkeys=11, seed=9)
+    st = shard_table(t, mesh)
+    from spark_rapids_jni_tpu.parallel.shuffle import (partition_counts,
+                                                       partition_ids)
+    counts = partition_counts(st, mesh, ["k"])
+    assert counts.shape == (NDEV, NDEV)
+    assert counts.sum() == t.num_rows
+    # oracle: recompute destinations locally per shard
+    dest = np.asarray(partition_ids(t.select(["k"]), NDEV))
+    shard_rows = t.num_rows // NDEV
+    for s in range(NDEV):
+        want = np.bincount(dest[s * shard_rows:(s + 1) * shard_rows],
+                           minlength=NDEV)
+        assert (counts[s] == want).all(), s
+
+
+def test_hot_key_shuffle_sized_from_counts(mesh):
+    """90% of rows share one key: buffers come from counts, no retry/raise."""
+    n = NDEV * 64
+    rng = np.random.default_rng(33)
+    k = np.where(rng.random(n) < 0.9, 7, rng.integers(100, 1000, n))
+    t = Table([Column.from_numpy(k.astype(np.int64)),
+               Column.from_numpy(np.arange(n, dtype=np.int64))], ["k", "v"])
+    st = shard_table(t, mesh)
+    out, ok, overflow = shuffle_table_padded(st, mesh, ["k"])
+    assert int(overflow) == 0
+    assert int(np.asarray(ok).sum()) == n
+    # capacity derives from the real max bucket, not ndev * shard_rows
+    from spark_rapids_jni_tpu.parallel.shuffle import (cap_bucket,
+                                                       partition_counts)
+    cap = cap_bucket(int(partition_counts(st, mesh, ["k"]).max()))
+    assert out.num_rows == NDEV * NDEV * cap
+    assert cap < t.num_rows  # tighter than the old worst-case shard_rows
+
+
+def test_hot_key_distributed_groupby(mesh):
+    n = NDEV * 64
+    rng = np.random.default_rng(34)
+    k = np.where(rng.random(n) < 0.9, 7, rng.integers(100, 120, n))
+    v = rng.integers(-50, 50, n)
+    t = Table([Column.from_numpy(k.astype(np.int64)),
+               Column.from_numpy(v.astype(np.int64),
+                                 validity=rng.random(n) > 0.3)], ["k", "v"])
+    st = shard_table(t, mesh)
+    got = distributed_groupby(st, mesh, ["k"], [("v", "sum"), ("v", "count")])
+    want = groupby(t, ["k"], [("v", "sum"), ("v", "count")])
+    gd = dict(zip(got["k"].to_pylist(),
+                  zip(got.columns[1].to_pylist(), got.columns[2].to_pylist())))
+    wd = dict(zip(want["k"].to_pylist(),
+                  zip(want.columns[1].to_pylist(), want.columns[2].to_pylist())))
+    assert gd == wd
+
+
+def test_hot_key_distributed_join_no_retry(mesh):
+    """Counts size the join exchange exactly on skewed keys (one attempt)."""
+    nl, nr = NDEV * 24, NDEV * 6
+    rng = np.random.default_rng(35)
+    lk = np.where(rng.random(nl) < 0.9, 3, rng.integers(10, 40, nl))
+    left = Table([Column.from_numpy(lk.astype(np.int64)),
+                  Column.from_numpy(np.arange(nl, dtype=np.int64))],
+                 ["k", "lv"])
+    right = Table([Column.from_numpy(np.arange(nr, dtype=np.int64) % 45),
+                   Column.from_numpy(np.arange(nr, dtype=np.int64) * 3)],
+                  ["k", "rv"])
+    from spark_rapids_jni_tpu.ops.join import inner_join
+    got = distributed_join(left, right, mesh, ["k"])
+    want = inner_join(left, right, ["k"])
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
