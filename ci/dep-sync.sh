@@ -1,0 +1,37 @@
+#!/bin/bash
+#
+# Dependency-bump bot (analog of the reference's ci/submodule-sync.sh:34-117,
+# which advances the cudf submodule nightly, runs `mvn verify`, and opens an
+# auto-merge PR).  Here: refresh build/deps.pin to the installed jax/jaxlib,
+# run the premerge gate, and commit on green to a bot branch.  PR opening /
+# auto-merge is deployment-specific and left to the hosting CI.
+
+set -ex
+cd "$(dirname "$0")/.."
+
+BRANCH=${BRANCH:-bot-dep-sync}
+
+python - <<'PY'
+import importlib.metadata as m
+lines = []
+for line in open("build/deps.pin"):
+    s = line.strip()
+    if not s or s.startswith("#"):
+        lines.append(line.rstrip("\n"))
+        continue
+    pkg = s.split("==")[0]
+    lines.append(f"{pkg}=={m.version(pkg)}")
+open("build/deps.pin", "w").write("\n".join(lines) + "\n")
+PY
+
+if git diff --quiet build/deps.pin; then
+    echo "dep-sync: pins already current"
+    exit 0
+fi
+
+ci/premerge.sh
+
+git checkout -B "$BRANCH"
+git add build/deps.pin
+git commit -m "Bump accelerator-stack pins to installed versions"
+echo "dep-sync: committed to $BRANCH (open a PR from here)"

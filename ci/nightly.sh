@@ -1,0 +1,18 @@
+#!/bin/bash
+#
+# Nightly build (analog of ci/nightly-build.sh): premerge + benchmarks +
+# wheel packaging with baked provenance.
+
+set -ex
+cd "$(dirname "$0")/.."
+
+ci/premerge.sh
+
+# benchmarks (runs on whatever backend jax selects; TPU when present)
+python bench.py | tee target/bench-nightly.json
+
+# wheel with provenance baked in (build/build-info ran in premerge)
+python -m pip wheel --no-deps --no-build-isolation -w target/dist . \
+    || python -m pip wheel --no-deps -w target/dist .
+
+echo "nightly: OK"

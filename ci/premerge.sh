@@ -1,0 +1,27 @@
+#!/bin/bash
+#
+# Premerge gate (analog of the reference's ci/premerge-build.sh): dep pin
+# check, native bridge build, full test suite on the 8-device virtual CPU
+# mesh, multi-chip dryrun.  Hardware-gated tests are excluded the way the
+# reference excludes CuFileTest (`-Dtest=*,!CuFileTest`): pytest marks them
+# `requires_tpu` and conftest skips them off-hardware.
+
+set -ex
+cd "$(dirname "$0")/.."
+
+build/dep-pin-check
+build/build-info
+
+# native bridge (C ABI client + optional JNI adapter when a JDK exists)
+cmake -S src/main/cpp -B target/cpp-build -G Ninja \
+      -DCMAKE_BUILD_TYPE=Release
+cmake --build target/cpp-build
+
+# full suite on the virtual 8-device CPU mesh (includes bridge round trip)
+python -m pytest tests/ -q
+
+# the driver's multi-chip entry must keep compiling + executing
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "premerge: OK"

@@ -28,4 +28,17 @@ from .columnar.column import Column  # noqa: E402
 from .columnar.table import Table  # noqa: E402
 
 __version__ = "0.1.0"
+
+
+def build_info() -> dict:
+    """Build provenance baked in by ``build/build-info`` (analog of the
+    reference's jar properties, build/build-info:27-41); falls back to
+    version-only metadata for source checkouts."""
+    try:
+        from ._build_info import BUILD_INFO
+        return dict(BUILD_INFO)
+    except ImportError:
+        return {"version": __version__, "revision": "unknown",
+                "branch": "unknown", "date": "unknown", "user": "unknown",
+                "url": "unknown"}
 __all__ = ["dtypes", "Column", "Table", "__version__"]

@@ -1,0 +1,81 @@
+"""L0 build/CI machinery (analog of the reference's build/ + ci/ scripts).
+
+The reference gates builds on submodule pin freshness
+(build/submodule-check:21-26) and bakes provenance into the jar
+(build/build-info:27-41); these tests exercise the TPU build's equivalents
+as real subprocesses.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run(cmd, **kw):
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, **kw)
+
+
+def test_dep_pin_check_passes_on_pinned_env():
+    r = run(["build/dep-pin-check"])
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_dep_pin_check_fails_on_drift(tmp_path):
+    pin = (REPO / "build" / "deps.pin").read_text()
+    bad = pin.replace("jax==", "jax==999.")
+    tmpbuild = tmp_path / "build"
+    tmpbuild.mkdir()
+    (tmpbuild / "deps.pin").write_text(bad)
+    script = (REPO / "build" / "dep-pin-check").read_text()
+    (tmpbuild / "dep-pin-check").write_text(script)
+    os.chmod(tmpbuild / "dep-pin-check", 0o755)
+    r = subprocess.run([str(tmpbuild / "dep-pin-check")], cwd=tmp_path,
+                      capture_output=True, text=True)
+    assert r.returncode != 0
+    assert "pinned" in r.stderr
+
+
+def test_dep_pin_check_skip_env():
+    env = dict(os.environ, DEP_CHECK_SKIP="1")
+    r = subprocess.run([str(REPO / "build" / "dep-pin-check")], cwd=REPO,
+                      capture_output=True, text=True, env=env)
+    assert r.returncode == 0
+    assert "skipped" in r.stdout
+
+
+def test_build_info_generates_provenance():
+    r = run(["build/build-info"])
+    assert r.returncode == 0, r.stderr
+    out = REPO / "spark_rapids_jni_tpu" / "_build_info.py"
+    assert out.exists()
+    ns = {}
+    exec(out.read_text(), ns)
+    info = ns["BUILD_INFO"]
+    assert info["version"] == "0.1.0"
+    assert len(info["revision"]) == 40  # a git SHA
+    assert info["date"].endswith("Z")
+
+
+def test_build_info_accessor():
+    import spark_rapids_jni_tpu as pkg
+    info = pkg.build_info()
+    assert info["version"] == pkg.__version__
+
+
+def test_ci_scripts_are_valid_bash():
+    for script in ["ci/premerge.sh", "ci/nightly.sh", "ci/dep-sync.sh",
+                   "build/build-in-docker", "build/dep-pin-check",
+                   "build/build-info"]:
+        r = run(["bash", "-n", script])
+        assert r.returncode == 0, f"{script}: {r.stderr}"
+        assert os.access(REPO / script, os.X_OK), f"{script} not executable"
+
+
+def test_dockerfile_present_and_pinned():
+    df = (REPO / "ci" / "Dockerfile").read_text()
+    assert "deps.pin" in df  # hermetic builds consume the pin
+    assert "premerge.sh" in df
