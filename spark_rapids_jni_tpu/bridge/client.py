@@ -197,6 +197,12 @@ class BridgeClient:
     def release(self, handle: int) -> None:
         self._call(P.OP_RELEASE, struct.pack("<Q", handle))
 
+    def metrics(self) -> dict:
+        """Server observability snapshot (per-op counts, errors, busy time,
+        live handles, open shm exports) — SURVEY §5 metrics role."""
+        import json
+        return json.loads(self._call(P.OP_METRICS))
+
     def live_count(self) -> int:
         (n,) = struct.unpack("<I", self._call(P.OP_LIVE_COUNT))
         return n

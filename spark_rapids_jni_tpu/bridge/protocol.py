@@ -44,6 +44,7 @@ OP_LIVE_COUNT = 8
 OP_SHUTDOWN = 9
 OP_FREE_SHM = 10
 OP_TABLE_META = 11
+OP_METRICS = 12
 
 STATUS_OK = 0
 STATUS_ERROR = 1

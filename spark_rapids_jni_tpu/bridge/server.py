@@ -23,6 +23,7 @@ import os
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -131,6 +132,11 @@ class BridgeServer:
         self._shutdown = threading.Event()
         self._conns_lock = threading.Lock()
         self._conns: set[socket.socket] = set()
+        # observability (SURVEY §5 metrics/logging): per-op counters the
+        # client reads over OP_METRICS; slf4j-analog logger from utils.config
+        self._metrics = {"ops": {}, "errors": 0, "busy_s": 0.0}
+        from ..utils.config import logger
+        self._log = logger()
 
     # -- op implementations ------------------------------------------------
     def _op_import_table(self, payload: bytes) -> bytes:
@@ -249,7 +255,18 @@ class BridgeServer:
             return self._op_free_shm(payload)
         if opcode == P.OP_TABLE_META:
             return self._op_table_meta(payload)
+        if opcode == P.OP_METRICS:
+            return self._op_metrics()
         raise ValueError(f"unknown opcode {opcode}")
+
+    def _op_metrics(self) -> bytes:
+        import json
+        snap = {"ops": dict(self._metrics["ops"]),
+                "errors": self._metrics["errors"],
+                "busy_s": round(self._metrics["busy_s"], 6),
+                "live_handles": self.handles.live_count(),
+                "open_exports": len(self._exports)}
+        return json.dumps(snap).encode()
 
     def serve_forever(self) -> None:
         try:
@@ -326,8 +343,15 @@ class BridgeServer:
                     return
                 try:
                     with self._dispatch_lock:
+                        t0 = time.perf_counter()
                         out = self._dispatch(opcode, payload)
+                        ops = self._metrics["ops"]
+                        ops[opcode] = ops.get(opcode, 0) + 1
+                        self._metrics["busy_s"] += time.perf_counter() - t0
                 except Exception as e:  # noqa: BLE001 — CATCH_STD analog
+                    self._metrics["errors"] += 1
+                    self._log.warning("op %d failed: %s: %s", opcode,
+                                      type(e).__name__, e)
                     status, resp = (P.STATUS_ERROR,
                                     f"{type(e).__name__}: {e}".encode())
                 else:

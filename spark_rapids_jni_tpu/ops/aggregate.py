@@ -35,6 +35,7 @@ from ..dtypes import DType, TypeId, INT64, FLOAT64
 from .order import SortKey, encode_keys, rows_differ_from_prev
 from .selection import gather_table
 from . import order as _order
+from ..utils.tracing import traced
 
 AGGS = ("sum", "min", "max", "mean", "count", "count_all")
 
@@ -152,6 +153,7 @@ def _agg_column(col: Column, op: str, order, seg, num_segments: int,
     raise ValueError(f"unknown aggregation {op!r}; expected one of {AGGS}")
 
 
+@traced("groupby_padded")
 def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
                    keys_cols: list | None = None, row_mask=None):
     """Jit-able core: (key_table_padded, agg_table_padded, ngroups).
@@ -201,6 +203,7 @@ def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
     return out_keys, out_aggs, ngroups
 
 
+@traced("groupby")
 def groupby(table: Table, key_names: list, aggs: list[tuple],
             names: list | None = None) -> Table:
     """GROUP BY key_names with aggregations [(column, op), ...] -> compact Table.

@@ -34,6 +34,7 @@ import numpy as np
 from ..columnar import Column
 from ..dtypes import DType, TypeId, BOOL8, STRING
 from .strings_common import to_padded_bytes, from_padded_bytes
+from ..utils.tracing import traced
 
 _U64 = jnp.uint64
 _I32 = jnp.int32
@@ -177,6 +178,7 @@ def _null_out(col: Column, ok):
     return ok if col.validity is None else (ok & col.validity)
 
 
+@traced("cast.to_integer")
 def cast_to_integer(col: Column, dtype: DType, ansi: bool = False) -> Column:
     """string -> byte/short/int/long with Spark CAST semantics."""
     if dtype.id not in _INT_BOUNDS:
@@ -211,6 +213,7 @@ def _keyword_match(mat, start, end, word: bytes):
     return m
 
 
+@traced("cast.to_float")
 def cast_to_float(col: Column, dtype: DType, ansi: bool = False) -> Column:
     """string -> float/double with Spark CAST semantics."""
     if dtype.id not in (TypeId.FLOAT32, TypeId.FLOAT64):
@@ -247,6 +250,7 @@ def cast_to_float(col: Column, dtype: DType, ansi: bool = False) -> Column:
     return Column.fixed(dtype, val, validity=valid)  # FLOAT64 stores bits
 
 
+@traced("cast.to_decimal")
 def cast_to_decimal(col: Column, dtype: DType, ansi: bool = False) -> Column:
     """string -> decimal32/64 at the target scale, HALF_UP rounding.
 
@@ -292,6 +296,7 @@ _TRUE_LITS = (b"t", b"true", b"y", b"yes", b"1")
 _FALSE_LITS = (b"f", b"false", b"n", b"no", b"0")
 
 
+@traced("cast.to_bool")
 def cast_to_bool(col: Column, ansi: bool = False) -> Column:
     """string -> boolean with Spark's accepted literal sets."""
     mat, lengths = to_padded_bytes(col)
@@ -330,6 +335,7 @@ def _int_to_digit_matrix(vals: jnp.ndarray, width: int):
     return out, total
 
 
+@traced("cast.from_integer")
 def cast_from_integer(col: Column) -> Column:
     """byte/short/int/long/decimal-unscaled -> string (Spark CAST)."""
     if not col.dtype.is_integral and not col.dtype.is_decimal \

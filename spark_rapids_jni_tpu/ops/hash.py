@@ -33,6 +33,7 @@ import numpy as np
 from ..columnar import Column, Table
 from ..dtypes import DType, TypeId, INT32, INT64
 from .strings_common import to_padded_bytes
+from ..utils.tracing import traced
 
 DEFAULT_SEED = 42  # Spark's seed for both hash() and xxhash64()
 
@@ -307,6 +308,7 @@ def _hash_table(table: Table, seed: int, int_fn, long_fn, bytes_fn, init_cast):
     return h
 
 
+@traced("murmur3_hash")
 def murmur3_hash(table: Table | Column, seed: int = DEFAULT_SEED) -> Column:
     """Spark ``hash(...)``: Murmur3_x86_32 chained across columns -> INT32."""
     def long_fn(v_u64, h):
@@ -319,6 +321,7 @@ def murmur3_hash(table: Table | Column, seed: int = DEFAULT_SEED) -> Column:
     return Column(INT32, data=jax.lax.bitcast_convert_type(h, jnp.int32))
 
 
+@traced("xxhash64")
 def xxhash64(table: Table | Column, seed: int = DEFAULT_SEED) -> Column:
     """Spark ``xxhash64(...)``: XXH64 chained across columns -> INT64."""
     def int_fn(v_u32, h):

@@ -30,6 +30,7 @@ from .hash import xxhash64
 from .order import normalize_f64_bits, normalize_f32_bits
 from .selection import gather_table
 from .strings_common import to_padded_bytes
+from ..utils.tracing import traced
 
 _I32 = jnp.int32
 
@@ -139,6 +140,7 @@ def _compact_pairs(li, ri, eq):
     return jnp.take(li, sel), jnp.take(ri, sel)
 
 
+@traced("inner_join")
 def inner_join(left: Table, right: Table, on_left, on_right=None,
                suffixes=("", "_r")) -> Table:
     """Inner equi-join; returns left columns then right non-key columns."""
@@ -198,6 +200,7 @@ def inner_join_padded(left: Table, right: Table, on_left, on_right,
     return (jnp.take(li, order), jnp.take(ri, order), live, npairs, overflow)
 
 
+@traced("left_join")
 def left_join(left: Table, right: Table, on_left, on_right=None,
               suffixes=("", "_r")) -> Table:
     on_right = on_right or on_left
@@ -249,6 +252,7 @@ def _matched_left_rows(left: Table, right: Table, on_left, on_right):
     return jnp.take(matched_unique, lseg_of_row)
 
 
+@traced("left_semi_join")
 def left_semi_join(left: Table, right: Table, on_left, on_right=None) -> Table:
     from .selection import nonzero_indices
     on_right = on_right or on_left
@@ -256,6 +260,7 @@ def left_semi_join(left: Table, right: Table, on_left, on_right=None) -> Table:
     return gather_table(left, nonzero_indices(matched))
 
 
+@traced("left_anti_join")
 def left_anti_join(left: Table, right: Table, on_left, on_right=None) -> Table:
     from .selection import nonzero_indices
     on_right = on_right or on_left
@@ -277,6 +282,7 @@ def _assemble(left, right, li, ri, on_left, on_right, suffixes, right_valid):
     return Table(list(lcols.columns) + list(rcols.columns), names)
 
 
+@traced("sort_merge_join")
 def sort_merge_join(left: Table, right: Table, on_left, on_right=None,
                     how: str = "inner") -> Table:
     """SortMergeJoin surface: the exchange plans in BASELINE.json configs[3]

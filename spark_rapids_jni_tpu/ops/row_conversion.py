@@ -35,6 +35,7 @@ import numpy as np
 
 from ..columnar import Column, PackedByteColumn, Table
 from ..dtypes import DType, TypeId, INT8, UINT8
+from ..utils.tracing import traced
 
 # Reference parity: per-batch byte ceiling from cudf's int32 list offsets
 # (row_conversion.cu:384-386) and 32-row batch alignment (:477-479).
@@ -304,6 +305,7 @@ def _from_rows_wire_jit(layout: RowLayout, wire_u32: jnp.ndarray, n: int):
 # public API (mirrors RowConversion.java:101-121)
 # ---------------------------------------------------------------------------
 
+@traced("convert_to_rows")
 def convert_to_rows(table: Table, max_batch_bytes: int = MAX_BATCH_BYTES) -> list[Column]:
     """Columnar table -> list of LIST<INT8> row-blob columns.
 
@@ -340,6 +342,7 @@ def convert_to_rows(table: Table, max_batch_bytes: int = MAX_BATCH_BYTES) -> lis
     return out
 
 
+@traced("convert_from_rows")
 def convert_from_rows(rows: Column, schema: Sequence[DType]) -> Table:
     """LIST<INT8> row blobs -> columnar table.
 

@@ -16,6 +16,7 @@ import numpy as np
 from ..columnar import Column, Table
 from .order import SortKey, sort_indices
 from .strings_common import to_padded_bytes, from_padded_bytes
+from ..utils.tracing import traced
 
 
 def nonzero_indices(mask: jnp.ndarray, count: int | None = None) -> jnp.ndarray:
@@ -65,6 +66,7 @@ def _filter_mask(mask) -> jnp.ndarray:
     return jnp.asarray(mask).astype(jnp.bool_)
 
 
+@traced("apply_boolean_mask")
 def apply_boolean_mask(table: Table, mask) -> Table:
     """Keep rows where mask is True.  Compaction runs on device; only the
     surviving-row *count* syncs to the host (output shape)."""
@@ -87,6 +89,7 @@ def apply_boolean_mask_padded(table: Table, mask):
     return gather_table(table, order, indices_valid=live), live, count
 
 
+@traced("sort_table")
 def sort_table(table: Table, keys: list[SortKey]) -> Table:
     """cudf sorted_order + gather as one call."""
     order = sort_indices(keys)

@@ -11,17 +11,22 @@ binary-search per row).  Same design here:
 - device side: ``searchsorted`` into the transition instants picks each row's
   offset — the vectorized form of the reference's per-thread binary search.
 
-Semantics match Spark's from_utc_timestamp/to_utc_timestamp: timestamps are
-micros since epoch; local->UTC resolves gaps/overlaps by using the offset in
-force *before* the wall-clock transition point (Java's earlier-offset rule
-for overlaps).  Transitions cover what the TZif tables enumerate (through
-2037 for rule-based zones; the trailing POSIX TZ string is not expanded —
-post-2037 rule-based conversions reuse the last known offset).
+Semantics match Spark's from_utc_timestamp/to_utc_timestamp: local->UTC
+resolves gaps/overlaps by using the offset in force *before* the wall-clock
+transition point (Java's earlier-offset rule for overlaps).  All four
+timestamp precisions are supported (SECONDS/MILLIS/MICROS/NANOS).
+
+Rule-based zones stay correct past the TZif enumeration horizon (2037): the
+trailing POSIX TZ footer string (v2+) is parsed and its DST rules expanded
+through ``EXPAND_THROUGH_YEAR``, matching what the JVM's ZoneRulesProvider
+computes from the same rules.
 """
 
 from __future__ import annotations
 
+import datetime
 import functools
+import re
 import struct
 
 import jax.numpy as jnp
@@ -34,6 +39,19 @@ _TZPATHS = ("/usr/share/zoneinfo", "/usr/lib/zoneinfo", "/etc/zoneinfo")
 
 MICROS = 1_000_000
 _SENTINEL = np.iinfo(np.int64).min // 2  # below any representable micros
+
+# How far past the TZif table the POSIX footer rules are expanded.  2200
+# covers any timestamp a NANOS column can represent (int64 nanos max out in
+# 2262) at ~2 transitions/year of table size.
+EXPAND_THROUGH_YEAR = 2200
+
+# ticks per second for each supported precision
+_TICKS = {
+    TypeId.TIMESTAMP_SECONDS: 1,
+    TypeId.TIMESTAMP_MILLISECONDS: 1_000,
+    TypeId.TIMESTAMP_MICROSECONDS: 1_000_000,
+    TypeId.TIMESTAMP_NANOSECONDS: 1_000_000_000,
+}
 
 
 def _read_tzif(name: str) -> bytes:
@@ -50,13 +68,132 @@ def _read_tzif(name: str) -> bytes:
     raise ValueError(f"unknown timezone {name!r}")
 
 
+# --- POSIX TZ footer (TZif v2+ trailing rule string) -----------------------
+
+_POSIX_NAME = r"(?:[A-Za-z]{3,}|<[A-Za-z0-9+\-]{3,}>)"
+_POSIX_OFF = r"([+-]?\d{1,2}(?::\d{1,2}(?::\d{1,2})?)?)"
+
+
+def _parse_posix_offset(s: str) -> int:
+    """POSIX offset (west-positive, local + offset = UTC) -> seconds."""
+    sign = -1 if s.startswith("-") else 1
+    parts = s.lstrip("+-").split(":")
+    sec = int(parts[0]) * 3600
+    if len(parts) > 1:
+        sec += int(parts[1]) * 60
+    if len(parts) > 2:
+        sec += int(parts[2])
+    return sign * sec
+
+
+def _parse_posix_time(s: str | None) -> int:
+    """Transition time-of-day (may be negative or >24h, TZ extension)."""
+    if not s:
+        return 2 * 3600
+    sign = -1 if s.startswith("-") else 1
+    parts = s.lstrip("+-").split(":")
+    sec = int(parts[0]) * 3600
+    if len(parts) > 1:
+        sec += int(parts[1]) * 60
+    if len(parts) > 2:
+        sec += int(parts[2])
+    return sign * sec
+
+
+def _rule_day(year: int, rule: str) -> datetime.date:
+    """Resolve an Mm.w.d / Jn / n date rule for one year."""
+    if rule.startswith("M"):
+        m, w, d = (int(x) for x in rule[1:].split("."))
+        # d-th weekday (0=Sunday) of week w (5 = last) in month m
+        first = datetime.date(year, m, 1)
+        want_wd = d % 7  # python: Monday=0 ... convert below
+        # python weekday(): Mon=0..Sun=6; POSIX: Sun=0..Sat=6
+        first_wd = (first.weekday() + 1) % 7
+        day1 = 1 + (want_wd - first_wd) % 7
+        day = day1 + (w - 1) * 7
+        # clamp week 5 = last occurrence
+        while True:
+            try:
+                out = datetime.date(year, m, day)
+                return out
+            except ValueError:
+                day -= 7
+    if rule.startswith("J"):  # 1..365, Feb 29 never counted
+        n = int(rule[1:])
+        d = datetime.date(year, 1, 1) + datetime.timedelta(days=n - 1)
+        if (datetime.date(year, 3, 1) - datetime.date(year, 1, 1)).days == 60 \
+                and n >= 60:  # leap year, day >= Mar 1
+            d += datetime.timedelta(days=1)
+        return d
+    n = int(rule)  # 0..365, leap day counted
+    return datetime.date(year, 1, 1) + datetime.timedelta(days=n)
+
+
+def _parse_posix_tz(footer: str):
+    """Parse a POSIX TZ string -> (std_off, dst_off, start_rule, end_rule).
+
+    Offsets are utoff seconds (east-positive, the TZif convention — POSIX
+    signs are inverted).  Returns None for rules this implementation cannot
+    expand; constant-offset strings return (std, None, None, None).
+    """
+    m = re.match(
+        rf"^{_POSIX_NAME}{_POSIX_OFF}"
+        rf"(?:({_POSIX_NAME})(?:{_POSIX_OFF})?"
+        rf"(?:,([^,/]+)(?:/([^,]+))?,([^,/]+)(?:/([^,]+))?)?)?$",
+        footer.strip())
+    if not m:
+        return None
+    std_posix = _parse_posix_offset(m.group(1))
+    std = -std_posix  # POSIX west-positive -> utoff east-positive
+    if not m.group(2):
+        return (std, None, None, None)
+    dst = -_parse_posix_offset(m.group(3)) if m.group(3) else std + 3600
+    if not m.group(4):
+        # DST name without rules: POSIX default rules (US); rare in TZif
+        start = ("M3.2.0", 2 * 3600)
+        end = ("M11.1.0", 2 * 3600)
+        return (std, dst, start, end)
+    start = (m.group(4), _parse_posix_time(m.group(5)))
+    end = (m.group(6), _parse_posix_time(m.group(7)))
+    return (std, dst, start, end)
+
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _expand_posix(footer: str, from_instant: int):
+    """Generate (instants, offsets) seconds-UTC from the footer rules for
+    all transitions strictly after ``from_instant`` through
+    EXPAND_THROUGH_YEAR.  Empty arrays when the footer is constant-offset
+    or unparseable."""
+    parsed = _parse_posix_tz(footer)
+    if not parsed or parsed[1] is None:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    std, dst, (start_rule, start_tod), (end_rule, end_tod) = parsed
+    year0 = max(1970, datetime.datetime.fromtimestamp(
+        max(from_instant, 0), datetime.timezone.utc).year)
+    inst, offs = [], []
+    for year in range(year0, EXPAND_THROUGH_YEAR + 1):
+        sd = _rule_day(year, start_rule)
+        ed = _rule_day(year, end_rule)
+        # start time is wall clock under std offset; end under dst offset
+        s_utc = (sd - _EPOCH).days * 86400 + start_tod - std
+        e_utc = (ed - _EPOCH).days * 86400 + end_tod - dst
+        for t, o in sorted([(s_utc, dst), (e_utc, std)]):
+            if t > from_instant:
+                inst.append(t)
+                offs.append(o)
+    return np.array(inst, np.int64), np.array(offs, np.int64)
+
+
 @functools.lru_cache(maxsize=None)
 def load_transitions(name: str) -> tuple[np.ndarray, np.ndarray]:
     """(instants int64[T] seconds-UTC, offsets int64[T] seconds) for a zone.
 
     ``offsets[i]`` is in force from ``instants[i]`` (inclusive) to
     ``instants[i+1]``; ``instants[0]`` is -inf sentinel carrying the earliest
-    known offset.
+    known offset.  Enumerated TZif transitions are extended by the expanded
+    POSIX footer rules (post-2037 correctness for rule-based zones).
     """
     raw = _read_tzif(name)
     if raw[:4] != b"TZif":
@@ -80,10 +217,16 @@ def load_transitions(name: str) -> tuple[np.ndarray, np.ndarray]:
         p += isstdcnt + isutcnt
         return times.astype(np.int64), idx, np.array(ttinfo, np.int64), p
 
+    footer = ""
     if version >= b"2":
         # skip the v1 block, parse the 64-bit v2 block
         _, _, _, end_v1 = parse_block(raw, 0, 4, ">i4")
-        times, idx, offsets_by_type, _ = parse_block(raw, end_v1, 8, ">i8")
+        times, idx, offsets_by_type, end_v2 = parse_block(raw, end_v1, 8,
+                                                          ">i8")
+        # trailing newline-enclosed POSIX TZ string (RFC 9636 §3.3)
+        tail = raw[end_v2:].decode("ascii", "replace")
+        if tail.startswith("\n"):
+            footer = tail[1:].split("\n", 1)[0]
     else:
         times, idx, offsets_by_type, _ = parse_block(raw, 0, 4, ">i4")
 
@@ -96,42 +239,51 @@ def load_transitions(name: str) -> tuple[np.ndarray, np.ndarray]:
     else:
         instants = np.array([_SENTINEL], np.int64)
         offs = np.array([first], np.int64)
+    if footer:
+        last = int(instants[-1]) if instants.size > 1 else 0
+        ext_i, ext_o = _expand_posix(footer, last)
+        if ext_i.size:
+            instants = np.concatenate([instants, ext_i])
+            offs = np.concatenate([offs, ext_o])
     return instants, offs
 
 
 @functools.lru_cache(maxsize=None)
-def _device_tables(name: str):
+def _device_tables(name: str, ticks: int = MICROS):
     instants, offs = load_transitions(name)
     # Scale only the real transitions: the -2^62 sentinel times 10^6 is a
     # multiple of 2^64 and wraps to 0, unsorting the table and breaking
     # searchsorted.  The sentinel stays pre-scaled (it is already below any
-    # micros value).
-    scaled = np.concatenate([[_SENTINEL], instants[1:] * MICROS])
-    return jnp.asarray(scaled), jnp.asarray(offs * MICROS)
+    # representable tick value).
+    scaled = np.concatenate([[_SENTINEL], instants[1:] * ticks])
+    return jnp.asarray(scaled), jnp.asarray(offs * ticks)
 
 
 @functools.lru_cache(maxsize=None)
-def _device_wall_tables(name: str):
-    """Cached (wall-clock transition instants, offsets) in micros for a zone.
+def _device_wall_tables(name: str, ticks: int = MICROS):
+    """Cached (wall-clock transition instants, offsets) for a zone.
 
-    ``wall[i]`` is the local wall-clock micros at which ``offs[i]`` takes
+    ``wall[i]`` is the local wall-clock tick at which ``offs[i]`` takes
     effect; sentinel stays pre-scaled (see _device_tables on int64 wrap).
     """
     instants, offs = load_transitions(name)
-    wall = np.concatenate([[_SENTINEL], instants[1:] * MICROS + offs[1:] * MICROS])
-    return jnp.asarray(wall), jnp.asarray(offs * MICROS)
+    wall = np.concatenate([[_SENTINEL],
+                           instants[1:] * ticks + offs[1:] * ticks])
+    return jnp.asarray(wall), jnp.asarray(offs * ticks)
 
 
-def _check_ts(col: Column):
-    if col.dtype.id != TypeId.TIMESTAMP_MICROSECONDS:
-        raise TypeError(
-            f"expected TIMESTAMP_MICROSECONDS, got {col.dtype!r}")
+def _check_ts(col: Column) -> int:
+    """Validate the column is a timestamp; return its ticks/second."""
+    ticks = _TICKS.get(col.dtype.id)
+    if ticks is None:
+        raise TypeError(f"expected a TIMESTAMP column, got {col.dtype!r}")
+    return ticks
 
 
 def utc_to_local(col: Column, zone: str) -> Column:
     """Spark from_utc_timestamp: shift a UTC instant to the zone's wall clock."""
-    _check_ts(col)
-    instants, offs = _device_tables(zone)
+    ticks = _check_ts(col)
+    instants, offs = _device_tables(zone, ticks)
     idx = jnp.clip(jnp.searchsorted(instants, col.data, side="right") - 1,
                    0, None)  # pre-sentinel timestamps take the earliest offset
     out = col.data + jnp.take(offs, idx)
@@ -144,8 +296,8 @@ def local_to_utc(col: Column, zone: str) -> Column:
     Gap/overlap resolution: the offset in force before the wall-clock
     transition wins (Java earlier-offset rule).
     """
-    _check_ts(col)
-    wall_dev, offs_dev = _device_wall_tables(zone)
+    ticks = _check_ts(col)
+    wall_dev, offs_dev = _device_wall_tables(zone, ticks)
     idx = jnp.searchsorted(wall_dev, col.data, side="right") - 1
     idx = jnp.clip(idx, 0, wall_dev.shape[0] - 1)
     out = col.data - jnp.take(offs_dev, idx)

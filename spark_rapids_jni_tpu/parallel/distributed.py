@@ -33,8 +33,9 @@ from ..ops.aggregate import groupby_padded
 from ..ops.row_conversion import fixed_width_layout, _to_row_words, \
     _from_row_words
 from .mesh import ROW_AXIS
+from ..utils.tracing import traced
 from .shuffle import (partition_ids, _bucket_scatter, cap_bucket,
-                      make_partition_counts, partition_counts)
+                      partition_counts)
 
 # (partial op emitted by the local pass, final re-aggregation op)
 _REAGG = {"sum": "sum", "count": "sum", "count_all": "sum",
@@ -284,6 +285,7 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
         check_vma=False)
 
 
+@traced("distributed_join")
 def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
                      on_right=None, how: str = "inner",
                      capacity: int | None = None,
@@ -414,6 +416,7 @@ def agg_out_dtype(col_dtype: DType, op: str) -> DType:
     raise ValueError(op)
 
 
+@traced("distributed_groupby")
 def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
                         aggs: list, capacity: int | None = None,
                         axis: str = ROW_AXIS,

@@ -36,6 +36,7 @@ from ..columnar import Column, Table
 from ..ops.hash import murmur3_hash
 from ..ops.row_conversion import RowLayout, _to_row_words, _from_row_words
 from .mesh import ROW_AXIS
+from ..utils.tracing import traced
 
 
 def partition_ids(key_table: Table, num_partitions: int) -> jnp.ndarray:
@@ -168,6 +169,7 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
     ))
 
 
+@traced("shuffle_table_padded")
 def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                          capacity: int | None = None,
                          axis: str = ROW_AXIS):
@@ -201,13 +203,8 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                     for k in keys)
     if capacity is None:
         # two-phase exchange: counts pass sizes the payload pass exactly
-        cfn = make_partition_counts(
-            mesh, key_idx, tuple(table.columns[i].dtype for i in key_idx),
-            axis)
-        counts = cfn(tuple(c.data for c in table.columns),
-                     tuple(c.validity for c in table.columns))
-        import numpy as _np
-        capacity = cap_bucket(int(_np.asarray(counts).max()))
+        capacity = cap_bucket(
+            int(partition_counts(table, mesh, list(key_idx), axis).max()))
     fn = make_shuffle(mesh, layout, key_idx,
                       tuple(table.columns[i].dtype for i in key_idx),
                       capacity, axis)

@@ -1,3 +1,5 @@
 from . import bitmask
+from . import config
+from . import tracing
 
-__all__ = ["bitmask"]
+__all__ = ["bitmask", "config", "tracing"]

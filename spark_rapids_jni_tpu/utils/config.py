@@ -1,0 +1,70 @@
+"""Runtime flag system (analog of the reference's config pass-through).
+
+The reference threads Maven ``-D`` properties through ant/cmake into compile
+definitions and JVM sysprops (reference pom.xml:79-103, 404-408;
+CONTRIBUTING.md:64-78 documents the table).  A jax library's equivalent is
+environment flags read once at import:
+
+| flag | default | reference analog |
+|---|---|---|
+| ``SRJT_TRACE``        | ``0``   | ``ai.rapids.cudf.nvtx.enabled`` (pom.xml:84,407) |
+| ``SRJT_PALLAS``       | ``auto``| ``GPU_ARCHS`` (kernel backend selection) |
+| ``SRJT_LOG_LEVEL``    | ``WARNING`` | ``RMM_LOGGING_LEVEL`` (pom.xml:81) |
+| ``SRJT_LEAK_DEBUG``   | ``0``   | ``ai.rapids.refcount.debug`` (pom.xml:85,406) |
+
+``refresh()`` re-reads the environment (tests use it); everything else
+reads the module-level singleton.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+
+def _bool_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Config:
+    trace: bool = False          # profiler annotations around ops
+    pallas: str = "auto"         # "auto" | "on" | "off"
+    log_level: str = "WARNING"
+    leak_debug: bool = False     # bridge handle-leak tracking verbosity
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            trace=_bool_flag("SRJT_TRACE", False),
+            pallas=os.environ.get("SRJT_PALLAS", "auto").strip().lower(),
+            log_level=os.environ.get("SRJT_LOG_LEVEL", "WARNING").upper(),
+            leak_debug=_bool_flag("SRJT_LEAK_DEBUG", False),
+        )
+
+
+config = Config.from_env()
+
+
+def refresh() -> Config:
+    """Re-read flags from the environment (returns the live singleton)."""
+    global config
+    new = Config.from_env()
+    config.trace = new.trace
+    config.pallas = new.pallas
+    config.log_level = new.log_level
+    config.leak_debug = new.leak_debug
+    logger().setLevel(config.log_level)
+    return config
+
+
+def logger() -> logging.Logger:
+    """The package logger (analog of the reference's slf4j-api single dep)."""
+    log = logging.getLogger("spark_rapids_jni_tpu")
+    if not log.handlers:
+        log.setLevel(config.log_level)
+    return log

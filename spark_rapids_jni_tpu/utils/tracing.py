@@ -1,0 +1,55 @@
+"""Profiling scopes: the NVTX-range analog (SURVEY §5).
+
+The reference wraps ops in NVTX ranges toggled by
+``ai.rapids.cudf.nvtx.enabled`` (reference pom.xml:84,407) so Nsight shows
+per-op spans.  The TPU equivalents:
+
+- ``jax.named_scope`` — always on: names the HLO ops an op emits, so XLA
+  dumps and profiler traces attribute work to engine ops (compile-time
+  metadata, zero runtime cost).
+- ``jax.profiler.TraceAnnotation`` — runtime spans on the host timeline,
+  enabled by ``SRJT_TRACE=1`` (visible in Perfetto via ``profile()``).
+- ``profile(logdir)`` — capture a full device trace
+  (``jax.profiler.trace``), the Nsight-session analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+from .config import config
+
+
+@contextlib.contextmanager
+def op_scope(name: str):
+    """Named scope + (when SRJT_TRACE=1) a host profiler annotation."""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(jax.named_scope(name))
+        if config.trace:
+            stack.enter_context(jax.profiler.TraceAnnotation(name))
+        yield
+
+
+def traced(name: str):
+    """Decorator form of ``op_scope`` for op entry points."""
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with op_scope(name):
+                return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def profile(logdir: str):
+    """Device+host trace capture; view in Perfetto/TensorBoard.
+
+    Usage::
+
+        with tracing.profile("/tmp/trace"):
+            run_query(...)
+    """
+    return jax.profiler.trace(logdir)

@@ -1,0 +1,81 @@
+"""Config flags, profiler scopes, bridge metrics (SURVEY §5 aux subsystems).
+
+Reference analogs: nvtx ranges toggled by ``ai.rapids.cudf.nvtx.enabled``
+(pom.xml:84,407), ``RMM_LOGGING_LEVEL`` (pom.xml:81), the refcount.debug
+leak tracking sysprop (pom.xml:85,406), slf4j logging.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.utils import config as cfg
+from spark_rapids_jni_tpu.utils import tracing
+
+
+def test_config_defaults():
+    c = cfg.Config.from_env() if "SRJT_TRACE" not in os.environ else None
+    assert cfg.config.pallas in ("auto", "on", "off")
+
+
+def test_config_refresh_reads_env(monkeypatch):
+    monkeypatch.setenv("SRJT_TRACE", "1")
+    monkeypatch.setenv("SRJT_LOG_LEVEL", "debug")
+    c = cfg.refresh()
+    assert c.trace is True
+    assert c.log_level == "DEBUG"
+    monkeypatch.delenv("SRJT_TRACE")
+    monkeypatch.setenv("SRJT_LOG_LEVEL", "WARNING")
+    c = cfg.refresh()
+    assert c.trace is False
+
+
+def test_op_scope_wraps_computation(monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setenv("SRJT_TRACE", "1")
+    cfg.refresh()
+    with tracing.op_scope("test_op"):
+        out = jnp.arange(8).sum()
+    assert int(out) == 28
+    monkeypatch.delenv("SRJT_TRACE")
+    cfg.refresh()
+
+
+def test_named_scope_lands_in_hlo():
+    """The named_scope must attribute HLO to the op (NVTX-range analog)."""
+    import jax
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.ops.hash import murmur3_hash
+
+    t = Table([Column.from_numpy(np.arange(16, dtype=np.int64))])
+    def f():
+        return murmur3_hash(t).data
+    text = jax.jit(f).lower().as_text(debug_info=True)
+    assert "murmur3_hash" in text
+
+
+def test_bridge_metrics(tmp_path):
+    from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
+    from spark_rapids_jni_tpu.columnar import Column, Table
+
+    sock = str(tmp_path / "bridge.sock")
+    proc = spawn_server(sock)
+    try:
+        c = BridgeClient(sock)
+        t = Table([Column.from_numpy(np.arange(10, dtype=np.int64))])
+        h = c.import_table(t)
+        m = c.metrics()
+        assert m["live_handles"] == 1
+        assert m["errors"] == 0
+        assert sum(m["ops"].values()) >= 2  # ping + import at least
+        assert m["busy_s"] >= 0
+        with pytest.raises(RuntimeError):
+            c.table_meta(999999)  # bad handle -> server-side error
+        m2 = c.metrics()
+        assert m2["errors"] == 1
+        c.release(h)
+        assert c.metrics()["live_handles"] == 0
+        c.shutdown_server()
+    finally:
+        proc.wait(timeout=10)
