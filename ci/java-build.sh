@@ -1,0 +1,20 @@
+#!/bin/bash
+#
+# Toolchain-gated Java build (the pattern the reference uses for
+# hardware-gated tests, ci/premerge-build.sh:28): compile + test the Java
+# surface when a JDK and maven exist, skip cleanly otherwise.  The JUnit
+# round-trip test additionally needs a running device server:
+#
+#   python -m spark_rapids_jni_tpu.bridge.server /tmp/tpubridge.sock &
+#   TPU_BRIDGE_SOCKET=/tmp/tpubridge.sock ci/java-build.sh
+
+set -e
+cd "$(dirname "$0")/.."
+
+if ! command -v javac >/dev/null || ! command -v mvn >/dev/null; then
+    echo "java-build: SKIPPED (no JDK/maven on this machine)"
+    exit 0
+fi
+
+mvn -B verify
+echo "java-build: OK"

@@ -108,6 +108,131 @@ Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRows(
   return (jlong)out;
 }
 
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TpuBridge_importTableNative(
+    JNIEnv *env, jclass, jintArray jtypes, jintArray jscales, jlong nrows,
+    jobjectArray jdata, jobjectArray jvalid) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  if (nrows < 0) {
+    throw_runtime(env, "negative row count");
+    return 0;
+  }
+  jsize ncols = env->GetArrayLength(jtypes);
+  std::vector<jint> types(ncols), scales(ncols);
+  env->GetIntArrayRegion(jtypes, 0, ncols, types.data());
+  env->GetIntArrayRegion(jscales, 0, ncols, scales.data());
+  /* copy Java buffers out before building descriptors so no JNI critical
+   * section spans the socket round trip */
+  std::vector<std::vector<uint8_t>> data(ncols), valid(ncols);
+  std::vector<tpub_col> cols(ncols);
+  for (jsize i = 0; i < ncols; i++) {
+    if (types[i] == 23 /* STRING */) {
+      /* this import surface is fixed-width-only; STRING needs offsets
+       * marshaling (HostTable has no offsets field yet) */
+      throw_runtime(env, "STRING columns are not supported by importTable");
+      return 0;
+    }
+    auto jb = (jbyteArray)env->GetObjectArrayElement(jdata, i);
+    if (!jb) {
+      throw_runtime(env, "null data buffer");
+      return 0;
+    }
+    jsize len = env->GetArrayLength(jb);
+    data[i].resize(len);
+    env->GetByteArrayRegion(jb, 0, len, (jbyte *)data[i].data());
+    env->DeleteLocalRef(jb);
+    cols[i].type_id = types[i];
+    cols[i].scale = scales[i];
+    cols[i].nrows = (int64_t)nrows;
+    cols[i].data = data[i].data();
+    cols[i].data_len = (int64_t)len;
+    cols[i].offsets = nullptr;
+    cols[i].validity = nullptr;
+    auto jv = (jbyteArray)env->GetObjectArrayElement(jvalid, i);
+    if (jv) {
+      jsize vlen = env->GetArrayLength(jv);
+      if ((int64_t)vlen < (int64_t)nrows) {
+        throw_runtime(env, "validity buffer shorter than numRows");
+        return 0;
+      }
+      valid[i].resize(vlen);
+      env->GetByteArrayRegion(jv, 0, vlen, (jbyte *)valid[i].data());
+      env->DeleteLocalRef(jv);
+      cols[i].validity = valid[i].data();
+    }
+  }
+  uint64_t out = 0;
+  if (tpub_import_table(ctx.get(), cols.data(), (int32_t)ncols, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_com_nvidia_spark_rapids_jni_TpuBridge_exportTableNative(JNIEnv *env,
+                                                             jclass,
+                                                             jlong handle) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return nullptr;
+  tpub_export ex;
+  if (tpub_export_table(ctx.get(), (uint64_t)handle, &ex) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return nullptr;
+  }
+  int32_t n = ex.ncols;
+  jintArray types = env->NewIntArray(n);
+  jintArray scales = env->NewIntArray(n);
+  jlongArray nrows = env->NewLongArray(1);
+  jclass byteArrCls = env->FindClass("[B");
+  jobjectArray data = env->NewObjectArray(n, byteArrCls, nullptr);
+  jobjectArray valid = env->NewObjectArray(n, byteArrCls, nullptr);
+  if (!types || !scales || !nrows || !data || !valid) {
+    tpub_free_export(&ex);
+    return nullptr; /* OutOfMemoryError already pending */
+  }
+  std::vector<jint> t(n), s(n);
+  jlong nr = n ? (jlong)ex.cols[0].nrows : 0;
+  for (int32_t i = 0; i < n; i++) {
+    if (ex.cols[i].type_id == 23 /* STRING */) {
+      /* offsets are not marshaled; corrupt data would be silent */
+      tpub_free_export(&ex);
+      throw_runtime(env, "STRING columns are not supported by exportTable");
+      return nullptr;
+    }
+    t[i] = ex.cols[i].type_id;
+    s[i] = ex.cols[i].scale;
+    jbyteArray d = env->NewByteArray((jsize)ex.cols[i].data_len);
+    if (!d) { tpub_free_export(&ex); return nullptr; }
+    env->SetByteArrayRegion(d, 0, (jsize)ex.cols[i].data_len,
+                            (const jbyte *)ex.cols[i].data);
+    env->SetObjectArrayElement(data, i, d);
+    env->DeleteLocalRef(d);
+    if (ex.cols[i].validity) {
+      jbyteArray v = env->NewByteArray((jsize)ex.cols[i].nrows);
+      if (!v) { tpub_free_export(&ex); return nullptr; }
+      env->SetByteArrayRegion(v, 0, (jsize)ex.cols[i].nrows,
+                              (const jbyte *)ex.cols[i].validity);
+      env->SetObjectArrayElement(valid, i, v);
+      env->DeleteLocalRef(v);
+    }
+  }
+  env->SetIntArrayRegion(types, 0, n, t.data());
+  env->SetIntArrayRegion(scales, 0, n, s.data());
+  env->SetLongArrayRegion(nrows, 0, 1, &nr);
+  tpub_free_export(&ex);
+  jclass objCls = env->FindClass("java/lang/Object");
+  jobjectArray out = env->NewObjectArray(5, objCls, nullptr);
+  if (!out) return nullptr;
+  env->SetObjectArrayElement(out, 0, types);
+  env->SetObjectArrayElement(out, 1, scales);
+  env->SetObjectArrayElement(out, 2, nrows);
+  env->SetObjectArrayElement(out, 3, data);
+  env->SetObjectArrayElement(out, 4, valid);
+  return out;
+}
+
 JNIEXPORT void JNICALL
 Java_com_nvidia_spark_rapids_jni_TpuBridge_releaseNative(JNIEnv *env, jclass,
                                                          jlong handle) {

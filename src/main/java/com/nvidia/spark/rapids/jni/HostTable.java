@@ -1,0 +1,33 @@
+/*
+ * Host-side fixed-width table: the staging shape that crosses the bridge at
+ * import/export.  Plays the role ai.rapids.cudf.HostColumnVector plays in
+ * the reference stack (SURVEY §2.2): raw Arrow-layout buffers (storage-dtype
+ * data, one validity byte per row) plus the flattened (type-id, scale)
+ * schema the reference marshals per call (reference RowConversion.java:113-118).
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class HostTable {
+  public final int[] typeIds;     // cudf-compatible type ids (dtypes.py)
+  public final int[] scales;      // decimal scale per column, 0 otherwise
+  public final long numRows;
+  public final byte[][] data;     // little-endian storage bytes per column
+  public final byte[][] validity; // one byte per row; null entry = all valid
+
+  public HostTable(int[] typeIds, int[] scales, long numRows,
+                   byte[][] data, byte[][] validity) {
+    if (typeIds.length != scales.length || typeIds.length != data.length
+        || typeIds.length != validity.length) {
+      throw new IllegalArgumentException("column count mismatch");
+    }
+    this.typeIds = typeIds;
+    this.scales = scales;
+    this.numRows = numRows;
+    this.data = data;
+    this.validity = validity;
+  }
+
+  public int numColumns() {
+    return typeIds.length;
+  }
+}

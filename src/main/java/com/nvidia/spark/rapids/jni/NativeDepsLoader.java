@@ -1,0 +1,66 @@
+/*
+ * Extracts the bridge native libraries from the jar and loads them.
+ *
+ * Mirrors the reference's NativeDepsLoader (SURVEY §3.3): the build packages
+ * .so files inside the jar under ${os.arch}/${os.name}/ (reference
+ * pom.xml:362-391); at first touch they are extracted to a temp directory
+ * and System.load()ed — libtpubridge.so first so the JNI adapter's
+ * dependency resolves without rpath games.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.io.File;
+import java.io.FileOutputStream;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.nio.file.Files;
+
+final class NativeDepsLoader {
+  private static boolean loaded = false;
+
+  private NativeDepsLoader() {}
+
+  /** Try the jar-resource path; false means fall back to java.library.path. */
+  static synchronized boolean loadFromJar() {
+    if (loaded) {
+      return true;
+    }
+    try {
+      String arch = System.getProperty("os.arch");
+      String os = System.getProperty("os.name");
+      File dir = Files.createTempDirectory("tpubridge").toFile();
+      dir.deleteOnExit();
+      File dep = extract(arch, os, "libtpubridge.so", dir);
+      File jni = extract(arch, os, "libtpubridge_jni.so", dir);
+      if (dep == null || jni == null) {
+        return false;
+      }
+      System.load(dep.getAbsolutePath());
+      System.load(jni.getAbsolutePath());
+      loaded = true;
+      return true;
+    } catch (Throwable t) {
+      return false;
+    }
+  }
+
+  private static File extract(String arch, String os, String name, File dir)
+      throws Exception {
+    String resource = "/" + arch + "/" + os + "/" + name;
+    try (InputStream in = NativeDepsLoader.class.getResourceAsStream(resource)) {
+      if (in == null) {
+        return null;
+      }
+      File out = new File(dir, name);
+      out.deleteOnExit();
+      try (OutputStream o = new FileOutputStream(out)) {
+        byte[] buf = new byte[1 << 16];
+        int n;
+        while ((n = in.read(buf)) > 0) {
+          o.write(buf, 0, n);
+        }
+      }
+      return out;
+    }
+  }
+}

@@ -13,13 +13,28 @@ package com.nvidia.spark.rapids.jni;
 
 public final class TpuBridge {
   static {
-    // libtpubridge_jni.so (which pulls libtpubridge.so via $ORIGIN rpath)
-    // is expected on java.library.path, unpacked from the jar the same way
-    // the reference's NativeDepsLoader extracts its .so resources.
-    System.loadLibrary("tpubridge_jni");
+    // Prefer jar-packaged libraries (NativeDepsLoader, the reference's
+    // loading model — pom.xml:362-391 packs .so under ${os.arch}/${os.name});
+    // fall back to java.library.path for build-tree runs.
+    if (!NativeDepsLoader.loadFromJar()) {
+      System.loadLibrary("tpubridge_jni");
+    }
   }
 
   private TpuBridge() {}
+
+  /** Stage a host table to the device; caller owns the returned handle. */
+  public static DeviceTable importTable(HostTable t) {
+    return new DeviceTable(importTableNative(
+        t.typeIds, t.scales, t.numRows, t.data, t.validity));
+  }
+
+  /** Fetch a device table back to host Arrow-layout buffers. */
+  public static HostTable exportTable(DeviceTable t) {
+    Object[] r = exportTableNative(t.getHandle());
+    return new HostTable((int[]) r[0], (int[]) r[1], ((long[]) r[2])[0],
+                         (byte[][]) r[3], (byte[][]) r[4]);
+  }
 
   /** Connect this JVM to the device server (idempotent). */
   public static synchronized void connect(String socketPath) {
@@ -43,4 +58,10 @@ public final class TpuBridge {
   private static native void disconnectNative();
   private static native void releaseNative(long handle);
   private static native int liveCountNative();
+  private static native long importTableNative(int[] typeIds, int[] scales,
+                                               long numRows, byte[][] data,
+                                               byte[][] validity);
+  // returns {int[] typeIds, int[] scales, long[] numRows, byte[][] data,
+  //          byte[][] validity}
+  private static native Object[] exportTableNative(long handle);
 }
