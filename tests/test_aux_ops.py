@@ -222,3 +222,58 @@ def test_device_transition_table_sorted():
     inst, _ = _device_tables("America/New_York")
     inst = np.asarray(inst)
     assert (np.diff(inst) > 0).all()
+
+
+@pytest.mark.parametrize("zone", ["America/New_York", "Europe/Paris",
+                                  "Australia/Sydney"])
+def test_post_2037_posix_footer_rules(zone):
+    """Rule-based zones past the TZif horizon follow the POSIX footer —
+    the JVM oracle (ZoneRulesProvider) computes from the same rules, here
+    approximated by zoneinfo which also expands them."""
+    from datetime import datetime, timezone
+    from zoneinfo import ZoneInfo
+    z = ZoneInfo(zone)
+    stamps = [(2040, 1, 15, 12, 0, 0), (2040, 7, 15, 12, 0, 0),
+              (2045, 3, 20, 0, 30, 0), (2050, 10, 10, 23, 59, 59),
+              (2199, 6, 1, 6, 0, 0)]
+    micros = [to_micros(*s) for s in stamps]
+    col = Column.fixed(dt.TIMESTAMP_MICROSECONDS, np.array(micros, np.int64))
+    got = np.asarray(utc_to_local(col, zone).data)
+    for g, m, s in zip(got, micros, stamps):
+        utc_dt = datetime(*s, tzinfo=timezone.utc)
+        off = z.utcoffset(utc_dt.astimezone(z)).total_seconds()
+        assert g - m == int(off) * 1_000_000, (zone, s)
+
+
+def test_all_timestamp_precisions_agree():
+    from datetime import datetime, timezone
+    zone = "America/New_York"
+    base_s = int(datetime(2039, 8, 1, 12, tzinfo=timezone.utc).timestamp())
+    cases = [
+        (dt.TIMESTAMP_SECONDS, 1),
+        (dt.TIMESTAMP_MILLISECONDS, 1_000),
+        (dt.TIMESTAMP_MICROSECONDS, 1_000_000),
+        (dt.TIMESTAMP_NANOSECONDS, 1_000_000_000),
+    ]
+    shifts = []
+    for dtype, ticks in cases:
+        col = Column.fixed(dtype, np.array([base_s * ticks], np.int64))
+        out = np.asarray(utc_to_local(col, zone).data)[0]
+        shifts.append((out - base_s * ticks) // ticks)
+    assert len(set(shifts)) == 1, shifts  # same offset in seconds
+    assert shifts[0] == -4 * 3600  # EDT
+
+
+def test_local_to_utc_post_2037():
+    from datetime import datetime
+    from zoneinfo import ZoneInfo
+    zone = "Europe/Paris"
+    z = ZoneInfo(zone)
+    # unambiguous local times, one in CET and one in CEST, year 2044
+    for s in [(2044, 1, 10, 9, 0, 0), (2044, 7, 10, 9, 0, 0)]:
+        local_us = to_micros(*s)  # wall-clock micros (built as if UTC)
+        col = Column.fixed(dt.TIMESTAMP_MICROSECONDS,
+                           np.array([local_us], np.int64))
+        got = np.asarray(local_to_utc(col, zone).data)[0]
+        want = int(datetime(*s, tzinfo=z).timestamp() * 1_000_000)
+        assert got == want, s
