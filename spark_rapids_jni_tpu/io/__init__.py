@@ -14,3 +14,5 @@ from .parquet import (  # noqa: F401
     ParquetFile,
     read_parquet,
 )
+from .parquet_writer import write_parquet  # noqa: F401
+from .csv import read_csv  # noqa: F401

@@ -1,0 +1,235 @@
+"""Parquet writer: device/host Tables -> standard parquet files.
+
+The write half of the libcudf-I/O role (reference build-libcudf.xml:37-50
+builds libcudf's parquet writer; the reference's Spark plugin writes shuffle
+and output files through it).  Flat schemas, data page V1, PLAIN encoding,
+RLE definition levels for nullable columns, optional snappy compression
+(native codec when linked, else uncompressed), min/max/null_count footer
+statistics on fixed-width columns — the subset our reader and predicate
+pruning consume, and pyarrow-readable (the round-trip tests use pyarrow as
+the independent reader oracle).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import dtypes as dt
+from ..columnar import Table
+from .thrift import (T_BINARY, T_I32, T_I64, T_LIST, T_STRUCT,
+                     _enc_varint, encode_struct)
+
+_MAGIC = b"PAR1"
+
+# physical types
+_PT_BOOLEAN, _PT_INT32, _PT_INT64 = 0, 1, 2
+_PT_FLOAT, _PT_DOUBLE, _PT_BYTE_ARRAY = 4, 5, 6
+
+# (physical, converted_type, widen_np) per supported dtype id
+_PHYS = {
+    dt.TypeId.BOOL8: (_PT_BOOLEAN, None, None),
+    dt.TypeId.INT8: (_PT_INT32, 15, np.int32),
+    dt.TypeId.INT16: (_PT_INT32, 16, np.int32),
+    dt.TypeId.INT32: (_PT_INT32, None, None),
+    dt.TypeId.INT64: (_PT_INT64, None, None),
+    dt.TypeId.UINT8: (_PT_INT32, 11, np.int32),
+    dt.TypeId.UINT16: (_PT_INT32, 12, np.int32),
+    dt.TypeId.UINT32: (_PT_INT32, 13, np.int32),
+    dt.TypeId.UINT64: (_PT_INT64, 14, np.int64),
+    dt.TypeId.FLOAT32: (_PT_FLOAT, None, None),
+    dt.TypeId.FLOAT64: (_PT_DOUBLE, None, None),
+    dt.TypeId.TIMESTAMP_DAYS: (_PT_INT32, 6, None),
+    dt.TypeId.TIMESTAMP_MILLISECONDS: (_PT_INT64, 9, None),
+    dt.TypeId.TIMESTAMP_MICROSECONDS: (_PT_INT64, 10, None),
+    dt.TypeId.STRING: (_PT_BYTE_ARRAY, 0, None),  # ConvertedType UTF8
+    dt.TypeId.DECIMAL32: (_PT_INT32, 5, None),
+    dt.TypeId.DECIMAL64: (_PT_INT64, 5, None),
+}
+
+from .parquet import _SNAPPY_NATIVE as _SNAPPY  # one codec handle for io/
+
+
+def _rle_bitpacked_bools(bits: np.ndarray) -> bytes:
+    """Definition levels (bit width 1) as one bit-packed hybrid run."""
+    n = len(bits)
+    groups = (n + 7) // 8
+    padded = np.zeros(groups * 8, np.uint8)
+    padded[:n] = bits.astype(np.uint8)
+    packed = np.packbits(padded, bitorder="little").tobytes()
+    header = bytearray()
+    _enc_varint(header, (groups << 1) | 1)
+    return bytes(header) + packed
+
+
+def _plain_values(col, dtype: dt.DType, valid) -> tuple[bytes, int]:
+    """(PLAIN-encoded non-null values, non-null count)."""
+    if dtype.is_string:
+        chars = np.asarray(col.data, np.uint8)
+        offs = np.asarray(col.offsets, np.int64)
+        lens = np.diff(offs)
+        keep = np.arange(len(lens)) if valid is None else np.flatnonzero(valid)
+        cb = chars.tobytes()
+        blob = bytearray()
+        for i in keep:
+            blob += int(lens[i]).to_bytes(4, "little")
+            blob += cb[offs[i]:offs[i + 1]]
+        return bytes(blob), len(keep)
+    vals = np.asarray(col.data)
+    if dtype.id == dt.TypeId.FLOAT64:
+        vals = vals.view(np.float64)  # stored as bit patterns
+    widen = _PHYS[dtype.id][2]
+    if widen is not None:
+        vals = vals.astype(widen)
+    if valid is not None:
+        vals = vals[valid]
+    if dtype.id == dt.TypeId.BOOL8:
+        return np.packbits(vals.astype(np.uint8), bitorder="little").tobytes(), \
+            len(vals)
+    return vals.tobytes(), len(vals)
+
+
+def _stats(col, dtype: dt.DType, valid):
+    """(min_bytes, max_bytes, null_count) or (None, None, null_count)."""
+    nulls = 0 if valid is None else int(len(valid) - valid.sum())
+    if dtype.is_string or dtype.id == dt.TypeId.BOOL8:
+        return None, None, nulls
+    vals = np.asarray(col.data)
+    if dtype.id == dt.TypeId.FLOAT64:
+        vals = vals.view(np.float64)
+    if valid is not None:
+        vals = vals[valid]
+    if len(vals) == 0:
+        return None, None, nulls
+    if vals.dtype.kind == "f" and np.isnan(vals).any():
+        # the spec forbids NaN in min/max; stats-trusting readers would
+        # mis-prune (NaN compares false) — omit min/max, keep null_count
+        return None, None, nulls
+    # order in the ORIGINAL dtype (unsigned stays unsigned), then encode the
+    # scalars at the physical width (readers decode physical-type bytes)
+    widen = _PHYS[dtype.id][2]
+    lo, hi = vals.min(), vals.max()
+    if widen is not None:
+        lo, hi = lo.astype(widen), hi.astype(widen)
+    return lo.tobytes(), hi.tobytes(), nulls
+
+
+def _schema_elements(table: Table, names, nullable) -> list:
+    root = [(4, T_BINARY, "schema"), (5, T_I32, table.num_columns)]
+    elements = [root]
+    for col, name, nl in zip(table.columns, names, nullable):
+        if col.dtype.id not in _PHYS:
+            raise NotImplementedError(
+                f"parquet write for {col.dtype!r} is not supported")
+        phys, conv, _ = _PHYS[col.dtype.id]
+        fields = [(1, T_I32, phys),
+                  (3, T_I32, 1 if nl else 0),
+                  (4, T_BINARY, name)]
+        if conv is not None:
+            fields.append((6, T_I32, conv))
+        if col.dtype.is_decimal:
+            # engine scale is the power-of-ten exponent (cudf convention);
+            # parquet scale counts digits right of the point
+            fields.append((7, T_I32, -col.dtype.scale))
+            fields.append((8, T_I32, 9 if col.dtype.id == dt.TypeId.DECIMAL32
+                           else 18))
+        elements.append(fields)
+    return elements
+
+
+def write_parquet(table: Table, path, compression: str = "snappy",
+                  row_group_size: int = 1 << 20) -> None:
+    """Write a Table to ``path`` as a standard parquet file."""
+    names = list(table.names or
+                 [f"c{i}" for i in range(table.num_columns)])
+    codec_id = 0
+    codec = None
+    if compression == "snappy" and _SNAPPY is not None:
+        codec_id, codec = 1, _SNAPPY
+    elif compression not in (None, "none", "snappy"):
+        raise ValueError(f"unsupported compression {compression!r}")
+
+    from ..ops.selection import slice_table
+    # nullability is a schema-level decision made once on the input table;
+    # slicing can materialize an all-true validity, which must not flip a
+    # REQUIRED column to writing definition levels
+    nullable = [c.validity is not None for c in table.columns]
+    out = bytearray(_MAGIC)
+    row_groups = []
+    n = table.num_rows
+    starts = list(range(0, max(n, 1), row_group_size))
+    for start in starts:
+        stop = min(n, start + row_group_size)
+        part = slice_table(table, start, stop - start) \
+            if (start, stop) != (0, n) else table
+        g_rows = stop - start
+        chunks = []
+        g_bytes = 0
+        for ci, (col, name) in enumerate(zip(part.columns, names)):
+            dtype = col.dtype
+            if nullable[ci]:
+                valid = np.ones(g_rows, np.bool_) if col.validity is None \
+                    else np.asarray(col.validity)
+            else:
+                valid = None
+            body = b""
+            if valid is not None:
+                lv = _rle_bitpacked_bools(valid)
+                body += len(lv).to_bytes(4, "little") + lv
+            vals, nnon = _plain_values(col, dtype, valid)
+            body += vals
+            comp = codec.compress(body, asbytes=True) if codec else body
+            smin, smax, nulls = _stats(col, dtype, valid)
+            stats_fields = [(3, T_I64, nulls)]
+            if smin is not None:
+                stats_fields += [(5, T_BINARY, smax), (6, T_BINARY, smin)]
+            header = encode_struct([
+                (1, T_I32, 0),                      # DATA_PAGE
+                (2, T_I32, len(body)),
+                (3, T_I32, len(comp)),
+                (5, T_STRUCT, [                     # DataPageHeader
+                    (1, T_I32, g_rows),
+                    (2, T_I32, 0),                  # PLAIN
+                    (3, T_I32, 3),                  # def levels RLE
+                    (4, T_I32, 3),                  # rep levels RLE
+                ]),
+            ])
+            page_off = len(out)
+            out += header
+            out += comp
+            phys = _PHYS[dtype.id][0]
+            meta = [
+                (1, T_I32, phys),
+                (2, T_LIST, (T_I32, [0, 3])),       # PLAIN, RLE
+                (3, T_LIST, (T_BINARY, [name])),
+                (4, T_I32, codec_id),
+                (5, T_I64, g_rows),
+                (6, T_I64, len(header) + len(body)),
+                (7, T_I64, len(header) + len(comp)),
+                (9, T_I64, page_off),
+                (12, T_STRUCT, stats_fields),
+            ]
+            chunks.append([(2, T_I64, page_off), (3, T_STRUCT, meta)])
+            g_bytes += len(header) + len(comp)
+        row_groups.append([
+            (1, T_LIST, (T_STRUCT, chunks)),
+            (2, T_I64, g_bytes),
+            (3, T_I64, g_rows),
+        ])
+        if n == 0:
+            break
+
+    schema = _schema_elements(table, names, nullable)
+    footer = encode_struct([
+        (1, T_I32, 1),                              # version
+        (2, T_LIST, (T_STRUCT, schema)),
+        (3, T_I64, n),
+        (4, T_LIST, (T_STRUCT, row_groups)),
+        (6, T_BINARY, "spark-rapids-jni-tpu"),
+    ])
+    out += footer
+    out += len(footer).to_bytes(4, "little")
+    out += _MAGIC
+    with open(os.fspath(path), "wb") as f:
+        f.write(out)

@@ -140,3 +140,72 @@ def decode_struct(buf: bytes, pos: int = 0):
     r = ThriftReader(buf, pos)
     fields = r.struct()
     return fields, r.pos
+
+
+# ---------------------------------------------------------------------------
+# encoder (the write side of the same wire grammar)
+# ---------------------------------------------------------------------------
+
+def _enc_varint(out: bytearray, n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return
+
+
+def _enc_zigzag(out: bytearray, n: int) -> None:
+    _enc_varint(out, (n << 1) ^ (n >> 63) if n < 0 else n << 1)
+
+
+def _enc_value(out: bytearray, ttype: int, value) -> None:
+    if ttype in (T_BYTE, T_I16, T_I32, T_I64):
+        _enc_zigzag(out, int(value))
+    elif ttype == T_BINARY:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        _enc_varint(out, len(data))
+        out.extend(data)
+    elif ttype == T_DOUBLE:
+        out.extend(struct.pack("<d", value))
+    elif ttype == T_LIST:
+        etype, items = value
+        if len(items) < 15:
+            out.append((len(items) << 4) | etype)
+        else:
+            out.append(0xF0 | etype)
+            _enc_varint(out, len(items))
+        for it in items:
+            _enc_value(out, etype, it)
+    elif ttype == T_STRUCT:
+        out.extend(encode_struct(value))
+    else:
+        raise ValueError(f"unsupported thrift encode type {ttype}")
+
+
+def encode_struct(fields) -> bytes:
+    """Encode [(field_id, type, value), ...] (ids ascending) to compact bytes.
+
+    Booleans pass ``T_TRUE`` with a bool value (the value rides in the type
+    nibble); lists pass ``(elem_type, [items])``; structs pass nested field
+    lists.  The mirror of ``ThriftReader.struct``.
+    """
+    out = bytearray()
+    last_id = 0
+    for fid, ttype, value in fields:
+        if value is None:
+            continue
+        wire_type = ttype
+        if ttype in (T_TRUE, T_FALSE):
+            wire_type = T_TRUE if value else T_FALSE
+        delta = fid - last_id
+        if 0 < delta <= 15:
+            out.append((delta << 4) | wire_type)
+        else:
+            out.append(wire_type)
+            _enc_zigzag(out, fid)
+        last_id = fid
+        if ttype not in (T_TRUE, T_FALSE):
+            _enc_value(out, ttype, value)
+    out.append(T_STOP)
+    return bytes(out)

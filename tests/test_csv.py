@@ -1,0 +1,75 @@
+"""CSV ingest vs pandas-written files (independent writer)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.io import read_csv
+
+
+def test_inference_and_nulls(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b,s,f\n1,true,x,1.5\n2,false,,2.5\n,true,zz,\n")
+    t = read_csv(p)
+    assert t["a"].to_pylist() == [1, 2, None]
+    assert t["s"].to_pylist() == ["x", None, "zz"]
+    assert t["f"].to_pylist() == [1.5, 2.5, None]
+    assert t["b"].to_pylist() == [True, False, True]
+
+
+def test_forced_dtypes(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("k,v\n1,10\n2,\n3,30\n")
+    t = read_csv(p, dtypes={"k": dt.INT32, "v": dt.INT64})
+    assert t["k"].dtype == dt.INT32
+    assert t["v"].dtype == dt.INT64
+    assert t["v"].to_pylist() == [10, None, 30]
+
+
+def test_no_header_and_delimiter(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("1|x\n2|y\n")
+    t = read_csv(p, delimiter="|", header=False, names=["n", "s"])
+    assert t["n"].to_pylist() == [1, 2]
+    assert t["s"].to_pylist() == ["x", "y"]
+
+
+def test_matches_pandas_roundtrip(tmp_path):
+    import pandas as pd
+    rng = np.random.default_rng(0)
+    n = 2000
+    df = pd.DataFrame({
+        "i": rng.integers(-10**9, 10**9, n),
+        "f": rng.standard_normal(n),
+        "s": [f"row{i % 101}" for i in range(n)],
+    })
+    p = tmp_path / "big.csv"
+    df.to_csv(p, index=False)
+    t = read_csv(p)
+    assert t["i"].to_pylist() == df["i"].tolist()
+    assert t["s"].to_pylist() == df["s"].tolist()
+    got_f = t["f"].to_pylist()
+    assert all(abs(a - b) < 1e-12 for a, b in zip(got_f, df["f"]))
+
+
+def test_forced_string_preserves_text(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("z\n007\n1.50\ntrue\n")
+    t = read_csv(p, dtypes={"z": dt.STRING})
+    assert t["z"].to_pylist() == ["007", "1.50", "true"]
+
+
+def test_forced_bool(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("i,b\n1,true\n2,false\n3,\n")
+    t = read_csv(p, dtypes={"b": dt.BOOL8})
+    assert t["b"].dtype == dt.BOOL8
+    assert t["b"].to_pylist() == [True, False, None]
+
+
+def test_bool_with_nulls_inferred(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("i,b\n1,true\n2,\n3,false\n")
+    t = read_csv(p)
+    assert t["b"].dtype == dt.BOOL8
+    assert t["b"].to_pylist() == [True, None, False]

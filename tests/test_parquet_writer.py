@@ -1,0 +1,146 @@
+"""Parquet writer vs pyarrow (independent reader oracle) + own-reader loop.
+
+The write half of the libcudf-I/O role: files we write must be readable by
+standard readers (pyarrow here, Spark in production) and by our own scan
+path, round-tripping values, nulls, decimals, and timestamps exactly.
+"""
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.io import (ParquetChunkedReader, ParquetFile,
+                                     read_parquet, write_parquet)
+
+
+def roundtrip_both(tmp_path, table, **kw):
+    p = tmp_path / "w.parquet"
+    write_parquet(table, p, **kw)
+    return pq.read_table(p), read_parquet(p), p
+
+
+def test_mixed_types_with_nulls(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 5000
+    t = Table([
+        Column.from_numpy(rng.integers(-2**62, 2**62, n).astype(np.int64),
+                          validity=rng.random(n) > 0.2),
+        Column.from_numpy(rng.standard_normal(n)),
+        Column.from_numpy(rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)),
+        Column.from_numpy(rng.random(n) > 0.5, dtype=dt.BOOL8),
+        Column.from_pylist([None if i % 7 == 0 else f"s{i % 53}×"
+                            for i in range(n)]),
+        Column.from_numpy(rng.integers(-10**8, 10**8, n).astype(np.int64),
+                          dtype=dt.decimal64(-2)),
+    ], ["a", "b", "f64", "bool", "s", "dec"])
+    at, rt, _ = roundtrip_both(tmp_path, t, row_group_size=1500)
+    for nm in t.names:
+        if nm == "b":
+            want = list(np.asarray(t["b"].data).view(np.float64))
+            assert at.column("b").to_pylist() == want
+            continue
+        assert at.column(nm).to_pylist() == t[nm].to_pylist(), nm
+    for nm in t.names:
+        assert rt[nm].to_pylist() == t[nm].to_pylist(), nm
+
+
+def test_uncompressed_mode(tmp_path):
+    t = Table([Column.from_numpy(np.arange(100, dtype=np.int64))], ["x"])
+    at, rt, _ = roundtrip_both(tmp_path, t, compression="none")
+    assert at.column("x").to_pylist() == list(range(100))
+    assert rt["x"].to_pylist() == list(range(100))
+
+
+def test_unsigned_and_small_ints(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 300
+    t = Table([
+        Column.from_numpy(rng.integers(0, 2**32 - 1, n).astype(np.uint32)),
+        Column.from_numpy((rng.integers(0, 2**63, n, dtype=np.int64)
+                           .astype(np.uint64) * 2 + 1)),
+        Column.from_numpy(rng.integers(-128, 128, n).astype(np.int8)),
+        Column.from_numpy(rng.integers(-2**15, 2**15, n).astype(np.int16)),
+    ], ["u32", "u64", "i8", "i16"])
+    at, rt, _ = roundtrip_both(tmp_path, t)
+    for nm in t.names:
+        assert at.column(nm).to_pylist() == t[nm].to_pylist(), nm
+        assert rt[nm].to_pylist() == t[nm].to_pylist(), nm
+
+
+def test_timestamps(tmp_path):
+    base = 1_600_000_000_000_000  # us
+    t = Table([
+        Column.from_numpy(np.arange(10, dtype=np.int64) * 86_400_000 + base
+                          // 1000, dtype=dt.TIMESTAMP_MILLISECONDS),
+        Column.from_numpy(np.arange(10, dtype=np.int64) * 86_400_000_000
+                          + base, dtype=dt.TIMESTAMP_MICROSECONDS),
+        Column.from_numpy(np.arange(10, dtype=np.int32) + 18000,
+                          dtype=dt.TIMESTAMP_DAYS),
+    ], ["ms", "us", "d"])
+    at, rt, _ = roundtrip_both(tmp_path, t)
+    assert [v.timestamp() for v in at.column("us").to_pylist()] == \
+        [(np.arange(10, dtype=np.int64) * 86_400_000_000 + base)[i] / 1e6
+         for i in range(10)]
+    for nm in t.names:
+        assert rt[nm].to_pylist() == t[nm].to_pylist(), nm
+
+
+def test_statistics_enable_pruning(tmp_path):
+    """Row-group stats written by us must drive our own predicate pruning."""
+    n = 4000
+    vals = np.sort(np.random.default_rng(3).integers(0, 10**6, n)).astype(
+        np.int64)
+    t = Table([Column.from_numpy(vals)], ["k"])
+    p = tmp_path / "w.parquet"
+    write_parquet(t, p, row_group_size=500)
+    f = ParquetFile(p)
+    assert f.num_row_groups == 8
+    st = f.group_stats(0, "k")
+    assert st is not None and st[0] == vals[0] and st[1] == vals[499]
+    lo, hi = int(vals[n // 2]), int(vals[n // 2 + 300])
+    got = sum(tl.num_rows for tl in ParquetChunkedReader(
+        p, predicate=("k", lo, hi)))
+    full = sum(tl.num_rows for tl in ParquetChunkedReader(p))
+    assert got < full  # pruning engaged
+    kept = [v for tl in ParquetChunkedReader(p, predicate=("k", lo, hi))
+            for v in tl["k"].to_pylist() if lo <= v <= hi]
+    want = [int(v) for v in vals if lo <= v <= hi]
+    assert sorted(kept) == want
+
+
+def test_empty_table(tmp_path):
+    t = Table([Column.from_numpy(np.zeros(0, np.int64)),
+               Column.from_pylist([])], ["a", "s"])
+    at, rt, _ = roundtrip_both(tmp_path, t)
+    assert at.num_rows == 0
+    assert rt.num_rows == 0
+
+
+def test_write_read_write_loop(tmp_path):
+    """Our writer -> our reader -> our writer -> pyarrow stays identical."""
+    rng = np.random.default_rng(5)
+    n = 1000
+    t = Table([
+        Column.from_numpy(rng.integers(-10**6, 10**6, n).astype(np.int64),
+                          validity=rng.random(n) > 0.1),
+        Column.from_pylist([f"v{i % 17}" for i in range(n)]),
+    ], ["x", "s"])
+    p1 = tmp_path / "w1.parquet"
+    write_parquet(t, p1)
+    t2 = read_parquet(p1)
+    p2 = tmp_path / "w2.parquet"
+    write_parquet(t2, p2)
+    at = pq.read_table(p2)
+    assert at.column("x").to_pylist() == t["x"].to_pylist()
+    assert at.column("s").to_pylist() == t["s"].to_pylist()
+
+
+def test_nan_floats_omit_minmax_stats(tmp_path):
+    t = Table([Column.from_numpy(np.array([1.0, np.nan, 5.0]))], ["f"])
+    p = tmp_path / "w.parquet"
+    write_parquet(t, p)
+    assert ParquetFile(p).group_stats(0, "f") is None  # no NaN min/max
+    got = pq.read_table(p).column("f").to_pylist()
+    assert got[0] == 1.0 and got[2] == 5.0 and np.isnan(got[1])
