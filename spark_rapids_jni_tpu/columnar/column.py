@@ -29,6 +29,26 @@ from ..dtypes import DType, TypeId, BOOL8, STRING, INT8, from_numpy_dtype
 from ..utils import bitmask
 
 
+def _decimal128_limbs(data) -> jnp.ndarray:
+    """Any reasonable 128-bit input -> int64[n, 2] limb pairs (lo, hi)."""
+    if hasattr(data, "devices"):  # already a device array
+        arr = jnp.asarray(data, jnp.int64)
+        if arr.ndim != 2 or arr.shape[-1] != 2:
+            raise TypeError("device DECIMAL128 data must be int64[n, 2]")
+        return arr
+    arr = np.asarray(data)
+    if arr.dtype.kind == "V":  # structured (lo, hi) storage
+        arr = arr.view(np.int64).reshape(-1, 2)
+    elif arr.dtype == object or arr.dtype.kind in "iu" and arr.ndim == 1:
+        ints = [int(v) for v in arr.tolist()]
+        lo = np.array([v & ((1 << 64) - 1) for v in ints], np.uint64)
+        hi = np.array([v >> 64 for v in ints], np.int64)
+        arr = np.stack([lo.view(np.int64), hi], axis=1)
+    if arr.ndim != 2 or arr.shape[-1] != 2:
+        raise TypeError("DECIMAL128 data must be int64[n, 2] limb pairs")
+    return jnp.asarray(arr.astype(np.int64, copy=False))
+
+
 class Column:
     __slots__ = ("dtype", "data", "validity", "offsets", "children")
 
@@ -49,6 +69,11 @@ class Column:
     # -- construction ------------------------------------------------------
     @staticmethod
     def fixed(dtype: DType, data, validity=None) -> "Column":
+        if dtype.id == TypeId.DECIMAL128:
+            if validity is not None:
+                validity = jnp.asarray(validity, dtype=jnp.bool_)
+            return Column(dtype, data=_decimal128_limbs(data),
+                          validity=validity)
         if dtype.id == TypeId.FLOAT64:
             # FLOAT64 stores IEEE bit patterns as int64 (dtypes.device_storage).
             # The rule is input-dtype based, identical for host and device
@@ -117,6 +142,8 @@ class Column:
             arr = arr.view(np.int64).astype(dtype.storage)
         if arr.dtype == np.bool_:
             arr = arr.astype(np.uint8)
+        if dtype.id == TypeId.DECIMAL128:
+            return Column.fixed(dtype, arr, validity)
         return Column.fixed(dtype, np.asarray(arr, dtype=dtype.storage), validity)
 
     @staticmethod
@@ -149,8 +176,12 @@ class Column:
             else:
                 dtype = INT64
         fill = values[0] if n and values[0] is not None else 0
-        dense = np.array([v if v is not None else fill for v in values],
-                         dtype=dtype.storage)
+        filled = [v if v is not None else fill for v in values]
+        if dtype.id == TypeId.DECIMAL128:
+            return Column.fixed(dtype, np.array([int(v) for v in filled],
+                                                object),
+                                valid if has_nulls else None)
+        dense = np.array(filled, dtype=dtype.storage)
         return Column.fixed(dtype, dense, valid if has_nulls else None)
 
     # -- basic properties --------------------------------------------------
@@ -214,6 +245,14 @@ class Column:
                 chars[offs[i]:offs[i + 1]].decode() if valid[i] else None
                 for i in range(self.size)
             ]
+        if self.dtype.id == TypeId.DECIMAL128:
+            import decimal
+            ctx = decimal.Context(prec=50)  # default 28 digits would round
+            limbs = np.asarray(self.data)
+            return [decimal.Decimal(
+                        (int(hi) << 64) | (int(lo) & ((1 << 64) - 1))
+                    ).scaleb(self.dtype.scale, ctx) if ok else None
+                    for (lo, hi), ok in zip(limbs.tolist(), valid)]
         if self.dtype.is_decimal:
             import decimal
             vals = np.asarray(self.data)

@@ -81,6 +81,10 @@ _STORAGE: dict[TypeId, np.dtype] = {
     TypeId.DURATION_NANOSECONDS: np.dtype(np.int64),
     TypeId.DECIMAL32: np.dtype(np.int32),
     TypeId.DECIMAL64: np.dtype(np.int64),
+    # 128-bit decimals: two little-endian 64-bit limbs (lo unsigned, hi
+    # signed two's complement) — byte-identical to cudf's __int128 storage.
+    # Device buffers hold the limbs as int64[n, 2] (no int128 in XLA).
+    TypeId.DECIMAL128: np.dtype([("lo", "<u8"), ("hi", "<i8")]),
 }
 
 _NUMERIC_IDS = {
@@ -165,6 +169,8 @@ class DType:
         """
         if self.id == TypeId.FLOAT64:
             return np.dtype(np.int64)
+        if self.id == TypeId.DECIMAL128:
+            return np.dtype(np.int64)  # as int64[n, 2] limb pairs
         return self.storage
 
     @property
@@ -211,6 +217,10 @@ def decimal64(scale: int) -> DType:
     return DType(TypeId.DECIMAL64, scale)
 
 
+def decimal128(scale: int) -> DType:
+    return DType(TypeId.DECIMAL128, scale)
+
+
 LIST = DType(TypeId.LIST)
 STRUCT = DType(TypeId.STRUCT)
 
@@ -232,6 +242,7 @@ def from_numpy_dtype(np_dtype) -> DType:
     for tid, storage in _STORAGE.items():
         if storage == np_dtype and tid not in (
             TypeId.BOOL8, TypeId.DECIMAL32, TypeId.DECIMAL64,
+            TypeId.DECIMAL128,
         ) and not (TypeId.TIMESTAMP_DAYS <= tid <= TypeId.DURATION_NANOSECONDS):
             return DType(tid)
     raise TypeError(f"unsupported numpy dtype {np_dtype}")

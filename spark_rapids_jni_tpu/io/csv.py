@@ -48,10 +48,13 @@ def read_csv(path, *, delimiter: str = ",", header: bool = True,
             return name.replace("int", "Int").replace("uInt", "UInt")
         return name
 
+    # nullable extension backend: an int column with NAs stays Int64 (plain
+    # numpy inference would promote to float64 and corrupt int64 > 2^53)
     df = pd.read_csv(
         os.fspath(path), sep=delimiter,
         header=0 if header else None, names=names,
         na_values=list(na_values), keep_default_na=True,
+        dtype_backend="numpy_nullable",
         dtype={k: _pd_dtype(v) for k, v in (dtypes or {}).items()})
     cols, out_names = [], []
     for name in df.columns:
@@ -82,13 +85,19 @@ def read_csv(path, *, delimiter: str = ",", header: bool = True,
                                na_value=0 if valid is not None else None)
             dtype = forced
         else:
-            arr = ser.to_numpy()
-            dtype = _infer_dtype(arr.dtype)
+            # strip the nullable-extension wrapper: "Int64" -> int64 etc.
+            base = str(ser.dtype)
+            np_name = {"boolean": "bool"}.get(base, base.lower())
+            try:
+                np_dtype = np.dtype(np_name)
+            except TypeError:
+                np_dtype = None
+            dtype = _infer_dtype(np_dtype) if np_dtype is not None else None
             if dtype is None:
                 raise NotImplementedError(
-                    f"CSV column {name!r} of dtype {arr.dtype} is unsupported")
-            if valid is not None and not np.issubdtype(arr.dtype, np.floating):
-                arr = np.where(valid, arr, 0).astype(dtype.storage)
+                    f"CSV column {name!r} of dtype {ser.dtype} is unsupported")
+            arr = ser.to_numpy(dtype=dtype.storage,
+                               na_value=0 if valid is not None else None)
         cols.append(Column.from_numpy(np.asarray(arr, dtype.storage),
                                       validity=valid, dtype=dtype))
     return Table(cols, out_names)

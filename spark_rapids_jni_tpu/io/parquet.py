@@ -652,11 +652,12 @@ class ParquetChunkedReader:
     """
 
     def __init__(self, path, pass_read_limit: int = 64 << 20, columns=None,
-                 predicate: tuple | None = None):
+                 predicate: tuple | None = None, prefetch: int = 0):
         self.file = ParquetFile(path)
         self.limit = int(pass_read_limit)
         self.columns = columns
         self.predicate = predicate
+        self.prefetch = int(prefetch)
         if self.limit <= 0:
             raise ValueError("pass_read_limit must be positive")
 
@@ -671,7 +672,7 @@ class ParquetChunkedReader:
         return (hi is not None and gmin > hi) or \
                (lo is not None and gmax < lo)
 
-    def __iter__(self):
+    def _chunks(self):
         for gi in range(self.file.num_row_groups):
             if self._group_pruned(gi):
                 continue
@@ -687,3 +688,60 @@ class ParquetChunkedReader:
                 sl = [h.slice(a, b) for h in hosts]
                 yield Table([h.to_column() for h in sl],
                             [h.schema.name for h in sl])
+
+    def __iter__(self):
+        if self.prefetch <= 0:
+            yield from self._chunks()
+            return
+        # Pipeline overlap (the per-thread-stream analog, SURVEY §2.3 "PP"):
+        # a worker thread decodes + stages chunk i+1..i+prefetch while the
+        # caller's device computation consumes chunk i.  jax dispatch is
+        # already async on the consumer side; this overlaps the HOST half
+        # (page decode, decompress) with it.  The queue bound keeps at most
+        # ``prefetch`` staged chunks of extra memory in flight.
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        DONE, FAIL = object(), object()
+
+        def put(item) -> bool:  # False once the consumer abandoned us
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for tbl in self._chunks():
+                    if not put(tbl):
+                        return
+                put(DONE)
+            except BaseException as e:  # surface decode errors to the consumer
+                put((FAIL, e))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is FAIL:
+                    raise item[1]
+                yield item
+        finally:
+            # early abandonment (LIMIT queries, consumer errors) must not
+            # leave the producer pinned on the bounded queue
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)

@@ -211,7 +211,7 @@ def write_parquet(table: Table, path, compression: str = "snappy",
                 (12, T_STRUCT, stats_fields),
             ]
             chunks.append([(2, T_I64, page_off), (3, T_STRUCT, meta)])
-            g_bytes += len(header) + len(comp)
+            g_bytes += len(header) + len(body)  # spec: uncompressed size
         row_groups.append([
             (1, T_LIST, (T_STRUCT, chunks)),
             (2, T_I64, g_bytes),

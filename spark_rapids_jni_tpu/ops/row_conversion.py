@@ -92,6 +92,11 @@ def _col_to_u32_parts(dtype: DType, data: jnp.ndarray) -> list[tuple[int, jnp.nd
     occupies the low ``byte_width`` bytes of the uint32.
     """
     size = dtype.itemsize
+    if size == 16:
+        # DECIMAL128: int64[n, 2] limb pairs -> four LE words
+        quad = jax.lax.bitcast_convert_type(data, jnp.uint32)  # (n, 2, 2)
+        return [(4, quad[..., 0, 0]), (4, quad[..., 0, 1]),
+                (4, quad[..., 1, 0]), (4, quad[..., 1, 1])]
     if size == 8:
         # FLOAT64 included: its device buffer already holds IEEE bit patterns
         # as int64 (dtypes.device_storage), so every 8-byte type is an integer
@@ -248,7 +253,12 @@ def _from_planes(layout: RowLayout, planes: list):
 
     for dt, off in zip(layout.schema, layout.offsets):
         size = dt.itemsize
-        if size == 8:
+        if size == 16:  # DECIMAL128 -> int64[n, 2] limb pairs
+            quad = jnp.stack([jnp.stack([word_at(off), word_at(off + 4)], -1),
+                              jnp.stack([word_at(off + 8), word_at(off + 12)],
+                                        -1)], axis=-2)
+            data = jax.lax.bitcast_convert_type(quad, jnp.int64)
+        elif size == 8:
             pair = jnp.stack([word_at(off), word_at(off + 4)], axis=-1)
             data = jax.lax.bitcast_convert_type(pair, jnp.int64)
             if dt.id != TypeId.FLOAT64:  # FLOAT64 keeps its bit-pattern buffer

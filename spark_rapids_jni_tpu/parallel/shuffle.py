@@ -137,12 +137,19 @@ def partition_counts(table: Table, mesh: Mesh, keys: list,
 
 @functools.lru_cache(maxsize=64)
 def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
-                 key_dtypes: tuple, capacity: int, axis: str = ROW_AXIS):
+                 key_dtypes: tuple, capacity: int, axis: str = ROW_AXIS,
+                 donate: bool = False):
     """Build the jitted shard_map shuffle for a fixed schema.
 
     Returns fn(datas, masks, row_mask) -> (rows, ok, overflow) where inputs
     are the row-sharded column buffers and outputs are row-sharded padded
     row-word matrices (ndev*capacity rows per shard).
+
+    ``donate=True`` donates the input buffers to XLA (donate_argnums — the
+    async-dispatch/donation half of the reference's per-thread-stream
+    overlap, SURVEY §2.3 "PP"): the send buffers reuse the table's HBM, so
+    a shuffle's working set is ~1x instead of 2x.  Callers must not touch
+    the donated table afterwards.
     """
     ndev = mesh.shape[axis]
 
@@ -166,13 +173,13 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
         in_specs=(spec, spec, spec),
         out_specs=(spec, spec, P()),
         check_vma=False,
-    ))
+    ), donate_argnums=(0, 1) if donate else ())
 
 
 @traced("shuffle_table_padded")
 def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                          capacity: int | None = None,
-                         axis: str = ROW_AXIS):
+                         axis: str = ROW_AXIS, donate: bool = False):
     """Shuffle a row-sharded table by key hash.
 
     Returns (padded Table [ndev * ndev * capacity global rows], row mask
@@ -207,7 +214,7 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
             int(partition_counts(table, mesh, list(key_idx), axis).max()))
     fn = make_shuffle(mesh, layout, key_idx,
                       tuple(table.columns[i].dtype for i in key_idx),
-                      capacity, axis)
+                      capacity, axis, donate)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
     rows, ok, overflow = fn(datas, masks, None)

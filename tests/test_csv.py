@@ -73,3 +73,13 @@ def test_bool_with_nulls_inferred(tmp_path):
     t = read_csv(p)
     assert t["b"].dtype == dt.BOOL8
     assert t["b"].to_pylist() == [True, None, False]
+
+
+def test_nullable_int64_inference_exact(tmp_path):
+    """Int columns with nulls must NOT promote to float64 (2^53 corruption)."""
+    p = tmp_path / "t.csv"
+    big = 9007199254740993  # 2^53 + 1: not representable in float64
+    p.write_text(f"i,v\n1,{big}\n2,\n3,{big + 2}\n")
+    t = read_csv(p)
+    assert t["v"].dtype == dt.INT64
+    assert t["v"].to_pylist() == [big, None, big + 2]

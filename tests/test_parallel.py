@@ -404,3 +404,18 @@ def test_hot_key_distributed_join_no_retry(mesh):
     want = inner_join(left, right, ["k"])
     got_r = Table([got[nm] for nm in want.names], list(want.names))
     assert _rows_set(got_r) == _rows_set(want)
+
+
+def test_shuffle_with_donation(mesh):
+    """donate=True consumes the input buffers; results stay identical."""
+    t = make_table(NDEV * 32, nkeys=9, seed=44)
+    st1 = shard_table(t, mesh)
+    out1, ok1, ovf1 = shuffle_table_padded(st1, mesh, ["k"])
+    st2 = shard_table(t, mesh)
+    out2, ok2, ovf2 = shuffle_table_padded(st2, mesh, ["k"], donate=True)
+    assert int(ovf2) == 0
+    def rows(out, ok):
+        okn = np.asarray(ok)
+        return sorted(zip(np.asarray(out["k"].data)[okn].tolist(),
+                          np.asarray(out["v"].data)[okn].tolist()))
+    assert rows(out1, ok1) == rows(out2, ok2)

@@ -292,3 +292,30 @@ class TestEdgeCases:
     def test_truncated_snappy_literal_raises(self):
         with pytest.raises(ValueError):
             snappy_decompress(b"\x05\x10ab")  # says 5 bytes, carries 2
+
+
+class TestPrefetch:
+    def test_prefetch_equals_serial(self, tmp_path):
+        rng = np.random.default_rng(9)
+        n = 20_000
+        tbl = pa.table({"a": pa.array(rng.integers(0, 10**6, n)),
+                        "s": pa.array([f"r{i % 97}" for i in range(n)])})
+        p = tmp_path / "t.parquet"
+        pq.write_table(tbl, p, row_group_size=2_500)
+        serial = [t.to_pydict() for t in
+                  ParquetChunkedReader(p, pass_read_limit=40_000)]
+        overlapped = [t.to_pydict() for t in ParquetChunkedReader(
+            p, pass_read_limit=40_000, prefetch=3)]
+        assert serial == overlapped
+        assert len(serial) > 4
+
+    def test_prefetch_surfaces_decode_errors(self, tmp_path):
+        p = tmp_path / "bad.parquet"
+        tbl = pa.table({"a": pa.array(range(100))})
+        pq.write_table(tbl, p)
+        raw = bytearray(p.read_bytes())
+        for i in range(4, 24):
+            raw[i] ^= 0xFF  # corrupt the first page header
+        p.write_bytes(bytes(raw))
+        with pytest.raises(Exception):
+            list(ParquetChunkedReader(p, prefetch=2))

@@ -205,3 +205,26 @@ def test_blob_child_list_invariant():
     assert isinstance(child, PackedByteColumn)
     assert int(np.asarray(blob.offsets)[-1]) == child.size
     assert child.bytes_numpy().size == child.size
+
+
+def test_decimal128_round_trip():
+    """DECIMAL128 (two int64 limbs, 16-byte aligned) through the wire."""
+    import decimal
+    vals = [12345678901234567890123456789,
+            -98765432109876543210987654321,
+            (1 << 126) - 1, -(1 << 126), 0, None]
+    d128 = dt.decimal128(-6)
+    t = Table([Column.from_pylist(vals, dtype=d128),
+               Column.from_numpy(np.arange(6, dtype=np.int64))])
+    layout = fixed_width_layout(t.dtypes())
+    assert layout.offsets[0] == 0 and layout.row_size % 8 == 0
+    blobs = convert_to_rows(t)
+    back = convert_from_rows(blobs[0], t.dtypes())
+    got = back.columns[0].to_pylist()
+    ctx = decimal.Context(prec=50)
+    for v, g in zip(vals, got):
+        if v is None:
+            assert g is None
+        else:
+            assert g == decimal.Decimal(v).scaleb(-6, ctx), v
+    assert back.columns[1].to_pylist() == list(range(6))
