@@ -122,6 +122,24 @@ def encode_key(key: SortKey) -> list[jnp.ndarray]:
     return words
 
 
+def decode_minmax_bits(red: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Invert ``_fixed_to_u64``'s float total-order transform.
+
+    ``red`` is a reduced (min/max) encoding word; returns the float column
+    data in its device-storage form (FLOAT64 -> int64 bit patterns).
+    """
+    from ..dtypes import TypeId
+    if dtype.id == TypeId.FLOAT64:
+        sign = (red & (jnp.uint64(1) << jnp.uint64(63))) != 0
+        bits = jnp.where(sign, red ^ (jnp.uint64(1) << jnp.uint64(63)), ~red)
+        return bits.astype(jnp.int64)
+    sign = (red & jnp.uint64(0x80000000)) != 0
+    bits32 = jnp.where(sign, red ^ jnp.uint64(0x80000000),
+                       ~red & jnp.uint64(0xFFFFFFFF))
+    return jax.lax.bitcast_convert_type(bits32.astype(jnp.uint32),
+                                        jnp.float32)
+
+
 def encode_keys(keys: list[SortKey]) -> list[jnp.ndarray]:
     """Primary-first flat u64 word list for a multi-column ordering."""
     out: list[jnp.ndarray] = []
