@@ -6,8 +6,9 @@ vectorize on TPU; instead:
 
     1. key each side with xxhash64 over the join columns (ops/hash.py)
     2. sort the build side by hash (radix sort)
-    3. searchsorted(left hashes) -> candidate range [lo, hi) per probe row
-    4. expand ranges to pairs with cumsum offsets + searchsorted inversion
+    3. merge-rank (sort + cumsum) -> candidate range [lo, hi) per probe row
+    4. expand ranges to pairs via marker/filler sort + cummax forward fill
+       (searchsorted binary search serializes on TPU — docs/PERF.md)
     5. verify true key equality per pair (hash collisions filtered exactly)
 
 The expansion size is data-dependent (it IS the join cardinality), so pair
@@ -19,6 +20,8 @@ verification pass; null-safe equality (<=>) is ``null_equal=True``.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -73,17 +76,41 @@ def _pair_equal(lcol: Column, rcol: Column, li, ri, null_equal: bool):
     return eq
 
 
+def _rank_bounds(ref, queries) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(lo, hi) ranks: count of ``ref`` elements < / <= each query.
+
+    The searchsorted replacement: TPU binary search serializes into ~20
+    rounds of slow gathers (docs/PERF.md); a merge-rank is one sort of
+    [refs, lo-copies, hi-copies] + cumsum + one unsort.  The tie tag
+    decides < vs <=: a lo-copy sorts before equal refs, a hi-copy after.
+    ``ref`` need not be sorted.
+    """
+    nq, nr = queries.shape[0], ref.shape[0]
+    vals = jnp.concatenate([queries, ref, queries])
+    tags = jnp.concatenate([jnp.zeros((nq,), jnp.int32),       # lo copies
+                            jnp.ones((nr,), jnp.int32),        # refs
+                            jnp.full((nq,), 2, jnp.int32)])    # hi copies
+    orig = jnp.concatenate([jnp.arange(nq, dtype=jnp.int32),
+                            jnp.full((nr,), 2 * nq, jnp.int32),
+                            jnp.arange(nq, 2 * nq, dtype=jnp.int32)])
+    _, st, so = jax.lax.sort((vals, tags, orig), num_keys=2, is_stable=True)
+    crs = jnp.cumsum((st == 1).astype(jnp.int32))  # refs at or before
+    _, rank_q = jax.lax.sort((so, crs), num_keys=1, is_stable=True)
+    return rank_q[:nq], rank_q[nq:2 * nq]
+
+
 def _probe_ranges(lh, rh):
     """Sorted-probe prelude: one sort of the build side, per-probe ranges.
 
-    Returns (r_order, offsets, starts, expansion) where probe row i's
+    Returns (r_order, lo, offsets, starts, expansion) where probe row i's
     candidates occupy sorted positions [lo, hi) recoverable from
     starts/offsets, and ``expansion`` is the total candidate-pair count.
     """
-    r_order = jnp.argsort(rh)
-    rh_sorted = jnp.take(rh, r_order)
-    lo = jnp.searchsorted(rh_sorted, lh, side="left").astype(_I32)
-    hi = jnp.searchsorted(rh_sorted, lh, side="right").astype(_I32)
+    r_order = jax.lax.sort(
+        (rh, jnp.arange(rh.shape[0], dtype=_I32)), num_keys=1,
+        is_stable=True)[1]
+    lo, hi = _rank_bounds(rh, lh)
+    lo, hi = lo.astype(_I32), hi.astype(_I32)
     counts = (hi - lo).astype(jnp.int64)
     offsets = jnp.cumsum(counts)
     starts = offsets - counts
@@ -96,15 +123,59 @@ def _expand_pairs(r_order, lo, offsets, starts, nl, nr, total):
 
     ``total`` may be a host int (exact size) or a static capacity; pairs
     beyond the true expansion get in_range=False.
+
+    Gather-free run inversion: probe rows with candidates become markers at
+    their run-start slot (unique), materialized against one filler per slot
+    by a keyed first-occurrence sort (the same trick as the shuffle's bucket
+    pack), then ``cummax`` forward-fills the run owner — both the probe-row
+    index and the run start are monotone in the slot index.
     """
+    if nl == 0:
+        z = jnp.zeros((total,), _I32)
+        return z, z, jnp.zeros((total,), jnp.bool_)
     j = jnp.arange(total, dtype=jnp.int64)
-    li = jnp.searchsorted(offsets, j, side="right").astype(_I32)
-    in_range = li < nl
+    counts = offsets - starts
+    mark_key = jnp.where(counts > 0, starts, jnp.int64(total + 1))
+    keys = jnp.concatenate([mark_key, j])
+    okv = jnp.concatenate([(counts > 0).astype(jnp.uint8),
+                           jnp.zeros((total,), jnp.uint8)])
+    idxs = jnp.concatenate([jnp.arange(nl, dtype=_I32),
+                            jnp.full((total,), nl, _I32)])
+    k1, o1, i1 = jax.lax.sort((keys, okv, idxs), num_keys=1, is_stable=True)
+    keep = jnp.concatenate([jnp.ones((1,), jnp.bool_), k1[1:] != k1[:-1]])
+    ck = jnp.where(keep, k1, jnp.int64(total + 2))
+    _, o2, i2 = jax.lax.sort((ck, o1, i1), num_keys=1, is_stable=True)
+    okc = o2[:total].astype(jnp.bool_)
+    li = jax.lax.cummax(jnp.where(okc, i2[:total], jnp.int32(-1)))
+    startj = jax.lax.cummax(jnp.where(okc, j.astype(jnp.int64),
+                                      jnp.int64(-1)))
+    in_range = (li >= 0) & (j < (offsets[-1] if nl else 0))
     li = jnp.clip(li, 0, max(nl - 1, 0))
-    within = (j - jnp.take(starts, li)).astype(_I32)
+    within = (j - startj).astype(_I32)
     ri_sorted_pos = jnp.clip(jnp.take(lo, li) + within, 0, max(nr - 1, 0))
     ri = jnp.take(r_order, ri_sorted_pos).astype(_I32)
     return li, ri, in_range
+
+
+@jax.jit
+def _probe_stage(lk: Table, rk: Table):
+    """Stage 1 as ONE compiled program (eager per-op dispatch costs a
+    network round trip per op on remotely-attached devices)."""
+    lh = xxhash64(lk).data
+    rh = xxhash64(rk).data
+    return (lh, rh) + _probe_ranges(lh, rh)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _expand_verify_stage(total: int, probe, lk: Table, rk: Table):
+    """Stage 2: enumerate candidate pairs + verify key equality."""
+    lh, rh, r_order, lo, offsets, starts, _ = probe
+    li, ri, _ = _expand_pairs(r_order, lo, offsets, starts,
+                              lh.shape[0], rh.shape[0], total)
+    eq = jnp.ones((total,), jnp.bool_)
+    for lc, rc in zip(lk.columns, rk.columns):
+        eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
+    return li, ri, eq, jnp.sum(eq.astype(jnp.int64))
 
 
 def _candidates(left: Table, right: Table, on_left, on_right):
@@ -115,21 +186,31 @@ def _candidates(left: Table, right: Table, on_left, on_right):
     """
     lk = _key_table(left, on_left)
     rk = _key_table(right, on_right)
-    lh = xxhash64(lk).data
-    rh = xxhash64(rk).data
-
-    r_order, lo, offsets, starts, expansion = _probe_ranges(lh, rh)
-    total = int(expansion) if lh.shape[0] else 0
+    # string keys size their padded matrices on the host (to_padded_bytes),
+    # so the string path runs its stages eagerly
+    has_string = any(c.dtype.is_string for c in lk.columns)
+    if has_string:
+        lh = xxhash64(lk).data
+        rh = xxhash64(rk).data
+        probe = (lh, rh) + _probe_ranges(lh, rh)
+    else:
+        probe = _probe_stage(lk, rk)
+    total = int(probe[-1]) if left.num_rows else 0
 
     if total == 0:
         z = jnp.zeros((0,), _I32)
         return z, z, jnp.zeros((0,), jnp.bool_), lk, rk
 
-    li, ri, _ = _expand_pairs(r_order, lo, offsets, starts,
-                              lh.shape[0], rh.shape[0], total)
-    eq = jnp.ones((total,), jnp.bool_)
-    for lc, rc in zip(lk.columns, rk.columns):
-        eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
+    if has_string:
+        lh, rh, r_order, lo, offsets, starts, _ = probe
+        li, ri, _ = _expand_pairs(r_order, lo, offsets, starts,
+                                  lh.shape[0], rh.shape[0], total)
+        eq = jnp.ones((total,), jnp.bool_)
+        for lc, rc in zip(lk.columns, rk.columns):
+            eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
+        return li, ri, eq, lk, rk
+
+    li, ri, eq, _ = _expand_verify_stage(total, probe, lk, rk)
     return li, ri, eq, lk, rk
 
 
@@ -269,10 +350,27 @@ def left_anti_join(left: Table, right: Table, on_left, on_right=None) -> Table:
 
 
 def _assemble(left, right, li, ri, on_left, on_right, suffixes, right_valid):
+    on_r = tuple(on_right) if isinstance(on_right, (list, tuple)) else on_right
+    if any(c.dtype.is_string for c in
+           list(left.columns) + list(right.columns)):
+        # string gathers size padded matrices on the host -> eager
+        return _assemble_body(left, right, li, ri, on_r, tuple(suffixes),
+                              right_valid)
+    return _assemble_jit(left, right, li, ri, on_r, tuple(suffixes),
+                         right_valid)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _assemble_jit(left, right, li, ri, on_right, suffixes, right_valid):
+    return _assemble_body(left, right, li, ri, on_right, suffixes,
+                          right_valid)
+
+
+def _assemble_body(left, right, li, ri, on_right, suffixes, right_valid):
     lcols = gather_table(left, li)
     rnames = right.names or [f"c{i}" for i in range(right.num_columns)]
     keep_r = [i for i, nm in enumerate(rnames)
-              if not (isinstance(on_right, (list, tuple)) and nm in on_right)]
+              if not (isinstance(on_right, tuple) and nm in on_right)]
     rsub = Table([right.columns[i] for i in keep_r],
                  [rnames[i] for i in keep_r])
     rcols = gather_table(rsub, ri, indices_valid=right_valid)

@@ -30,11 +30,11 @@ from jax import shard_map
 from ..columnar import Column, Table
 from ..dtypes import DType, TypeId, INT64, FLOAT64
 from ..ops.aggregate import groupby_padded
-from ..ops.row_conversion import fixed_width_layout, _to_row_words, \
-    _from_row_words
+from ..ops.row_conversion import fixed_width_layout, _build_planes, \
+    _from_planes
 from .mesh import ROW_AXIS
 from ..utils.tracing import traced
-from .shuffle import (partition_ids, _bucket_scatter, cap_bucket,
+from .shuffle import (partition_ids, cap_bucket, exchange_planes,
                       partition_counts)
 
 # (partial op emitted by the local pass, final re-aggregation op)
@@ -121,19 +121,15 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
         pdatas = tuple(c.data for c in partial_tbl.columns)
         pmasks = tuple(c.validity for c in partial_tbl.columns)
 
-        # 2. exchange partial groups by key hash (row blobs over ICI)
+        # 2. exchange partial groups by key hash (word planes over ICI)
         key_cols = [partial_tbl.column(i) for i in range(len(key_names))]
         dest = partition_ids(Table(key_cols), ndev)
-        rows = _to_row_words(playout, pdatas, pmasks)
-        send, ok, overflow = _bucket_scatter(rows, dest, live_local, ndev,
-                                             capacity)
-        recv = jax.lax.all_to_all(send, axis, 0, 0)
-        rok = jax.lax.all_to_all(ok, axis, 0, 0)
-        rows_in = recv.reshape(ndev * capacity, rows.shape[1])
-        mask_in = rok.reshape(ndev * capacity)
+        planes = _build_planes(playout, pdatas, pmasks)
+        planes_in, mask_in, overflow = exchange_planes(
+            planes, dest, live_local, ndev, capacity, axis)
 
         # 3. final aggregation over received partials
-        rdatas, rmasks = _from_row_words(playout, rows_in)
+        rdatas, rmasks = _from_planes(playout, planes_in)
         rtbl = Table([Column(dt, data=d, validity=m) for dt, d, m in
                       zip(playout.schema, rdatas, rmasks)],
                      list(partial_tbl.names))
@@ -217,13 +213,10 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
                      for dt_, d, m in zip(schema, datas, masks)], list(names))
         keys = [tbl.column(k) for k in key_names]
         dest = partition_ids(Table(keys), ndev)
-        rows = _to_row_words(layout, datas, masks)
-        send, ok, overflow = _bucket_scatter(rows, dest, None, ndev, cap)
-        recv = jax.lax.all_to_all(send, axis, 0, 0)
-        rok = jax.lax.all_to_all(ok, axis, 0, 0)
-        rows_in = recv.reshape(ndev * cap, rows.shape[1])
-        live_in = rok.reshape(ndev * cap)
-        d_in, m_in = _from_row_words(layout, rows_in)
+        planes = _build_planes(layout, datas, masks)
+        planes_in, live_in, overflow = exchange_planes(
+            planes, dest, None, ndev, cap, axis)
+        d_in, m_in = _from_planes(layout, list(planes_in))
         tbl_in = Table([Column(dt_, data=d, validity=m)
                         for dt_, d, m in zip(layout.schema, d_in, m_in)],
                        list(names))

@@ -7,10 +7,10 @@ them (RowConversion.java:28-31 documents row blobs as the hand-off format to
 Spark's shuffle) — except both halves now live in one jitted XLA program:
 
     per shard:  dest = pmod(murmur3(keys), ndev)          (Spark partitioning)
-                rows = row-word matrix (ops/row_conversion)
-                bucket-scatter into send[ndev, capacity, row_words]
-    exchange:   lax.all_to_all over the mesh axis (ICI)
-    per shard:  received padded rows + validity mask (+ overflow count)
+                word planes (ops/row_conversion._build_planes)
+                sort-based bucket pack into (nw, ndev, capacity) planes
+    exchange:   one dense lax.all_to_all block over the mesh axis (ICI)
+    per shard:  received padded word planes + row mask (+ overflow count)
 
 Static shapes everywhere: each source shard may send at most ``capacity``
 rows to each destination.  Capacity comes from a TWO-PHASE exchange (SURVEY
@@ -34,7 +34,8 @@ from jax import shard_map
 
 from ..columnar import Column, Table
 from ..ops.hash import murmur3_hash
-from ..ops.row_conversion import RowLayout, _to_row_words, _from_row_words
+from ..ops.row_conversion import (RowLayout, _build_planes,
+                                  _from_planes)
 from .mesh import ROW_AXIS
 from ..utils.tracing import traced
 
@@ -46,26 +47,61 @@ def partition_ids(key_table: Table, num_partitions: int) -> jnp.ndarray:
     return jnp.where(m < 0, m + jnp.int32(num_partitions), m)
 
 
-def _bucket_scatter(rows: jnp.ndarray, dest: jnp.ndarray, row_mask,
-                    ndev: int, capacity: int):
-    """Scatter shard rows into send[ndev, capacity, nw] by destination."""
-    n, nw = rows.shape
+def _bucket_pack_planes(planes, dest: jnp.ndarray, row_mask, ndev: int,
+                        capacity: int):
+    """Scatter-free bucket pack: rows into per-destination slots via sorts.
+
+    Sort-carried rather than scatter-based (docs/PERF.md: TPU scatters
+    serialize; multi-operand sorts don't).  ``planes`` is the
+    word-major row decomposition (nw dense u32[n] vectors — never the
+    lane-padded (n, nw) matrix).  Returns (send_planes [(ndev, capacity)
+    u32 per word], ok (ndev, capacity) bool, overflow scalar).
+
+    Slot assignment: pos = running count of earlier same-dest rows (one
+    cumsum per destination, ndev is small and static); slot = dest*cap+pos,
+    unique per row.  Slots materialize by sorting real rows against one
+    filler row per slot (stable, real first), keeping first-per-slot, and
+    compacting with a second sort.
+    """
+    n = dest.shape[0]
+    S = ndev * capacity
+    live = None
     if row_mask is not None:
-        dest = jnp.where(row_mask, dest, jnp.int32(ndev))  # parked -> dropped
-    order = jnp.argsort(dest, stable=True)
-    dsort = jnp.take(dest, order)
-    start = jnp.searchsorted(dsort, jnp.arange(ndev, dtype=dsort.dtype),
-                             side="left").astype(jnp.int32)
-    pos = jnp.arange(n, dtype=jnp.int32) - jnp.take(
-        start, jnp.clip(dsort, 0, ndev - 1))
-    in_bounds = (pos < capacity) & (dsort < ndev)
-    send = jnp.zeros((ndev, capacity, nw), rows.dtype)
-    send = send.at[dsort, pos].set(jnp.take(rows, order, axis=0), mode="drop")
-    ok = jnp.zeros((ndev, capacity), jnp.bool_)
-    ok = ok.at[dsort, pos].set(in_bounds, mode="drop")
-    sent = jnp.sum(in_bounds.astype(jnp.int32))
-    live = n if row_mask is None else jnp.sum(row_mask.astype(jnp.int32))
-    overflow = live - sent
+        live = row_mask
+        dest = jnp.where(row_mask, dest, jnp.int32(ndev))
+    if ndev <= 16:
+        # O(ndev * n) but each pass is one fast cumsum; wins at small meshes
+        pos = jnp.zeros((n,), jnp.int32)
+        for d in range(ndev):
+            hit = dest == d
+            pos = jnp.where(hit, jnp.cumsum(hit.astype(jnp.int32)) - 1, pos)
+    else:
+        # pod-scale: rank within destination via one sort + forward fill,
+        # cost independent of ndev
+        idx = jnp.arange(n, dtype=jnp.int32)
+        sd, si = jax.lax.sort((dest, idx), num_keys=1, is_stable=True)
+        firstm = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                  sd[1:] != sd[:-1]])
+        run_start = jax.lax.cummax(jnp.where(firstm, idx, jnp.int32(-1)))
+        spos = idx - run_start
+        _, pos = jax.lax.sort((si, spos), num_keys=1, is_stable=True)
+    in_bounds = (dest < ndev) & (pos < capacity)
+    slot = jnp.where(in_bounds, dest * capacity + pos, jnp.int32(S))
+    nlive = jnp.sum((dest < ndev).astype(jnp.int32)) if live is None else \
+        jnp.sum(live.astype(jnp.int32))
+    overflow = nlive - jnp.sum(in_bounds.astype(jnp.int32))
+
+    keys = jnp.concatenate([slot, jnp.arange(S, dtype=jnp.int32)])
+    okv = jnp.concatenate([in_bounds.astype(jnp.uint8),
+                           jnp.zeros((S,), jnp.uint8)])
+    pls = [jnp.concatenate([p, jnp.zeros((S,), p.dtype)]) for p in planes]
+    s1 = jax.lax.sort((keys, okv) + tuple(pls), num_keys=1, is_stable=True)
+    k1 = s1[0]
+    keep = jnp.concatenate([jnp.ones((1,), jnp.bool_), k1[1:] != k1[:-1]])
+    ckey = jnp.where(keep, k1, jnp.int32(S + 1))
+    s2 = jax.lax.sort((ckey,) + tuple(s1[1:]), num_keys=1, is_stable=True)
+    ok = s2[1][:S].astype(jnp.bool_).reshape(ndev, capacity)
+    send = [p[:S].reshape(ndev, capacity) for p in s2[2:]]
     return send, ok, overflow
 
 
@@ -135,15 +171,34 @@ def partition_counts(table: Table, mesh: Mesh, keys: list,
     return np.asarray(fn(datas, masks))
 
 
+def exchange_planes(planes, dest, row_mask, ndev: int, capacity: int,
+                    axis: str):
+    """Bucket-pack word planes and move them over ICI as ONE dense block.
+
+    The single exchange primitive shared by the raw shuffle and the
+    distributed groupby/join plans: pack -> stack (nw, ndev, cap) ->
+    all_to_all(split/concat axis 1) -> per-word receive planes.  Returns
+    (planes_in tuple of u32[ndev*capacity], row mask, overflow scalar).
+    """
+    send, ok, overflow = _bucket_pack_planes(planes, dest, row_mask, ndev,
+                                             capacity)
+    block = jnp.stack(send, axis=0)
+    recv = jax.lax.all_to_all(block, axis, 1, 1)
+    rok = jax.lax.all_to_all(ok, axis, 0, 0)
+    planes_in = tuple(recv[w].reshape(ndev * capacity)
+                      for w in range(len(planes)))
+    return planes_in, rok.reshape(ndev * capacity), overflow
+
+
 @functools.lru_cache(maxsize=64)
 def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
                  key_dtypes: tuple, capacity: int, axis: str = ROW_AXIS,
                  donate: bool = False):
     """Build the jitted shard_map shuffle for a fixed schema.
 
-    Returns fn(datas, masks, row_mask) -> (rows, ok, overflow) where inputs
-    are the row-sharded column buffers and outputs are row-sharded padded
-    row-word matrices (ndev*capacity rows per shard).
+    Returns fn(datas, masks, row_mask) -> (planes_in, ok, overflow): the
+    received word planes (tuple of u32[ndev*capacity] per row word — feed
+    ``_from_planes``), the live-row mask, and the global overflow count.
 
     ``donate=True`` donates the input buffers to XLA (donate_argnums — the
     async-dispatch/donation half of the reference's per-thread-stream
@@ -158,14 +213,10 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
                            validity=None if masks[i] is None else masks[i])
                     for kd, i in zip(key_dtypes, key_idx)]
         dest = partition_ids(Table(key_cols), ndev)
-        rows = _to_row_words(layout, datas, masks)
-        send, ok, overflow = _bucket_scatter(rows, dest, row_mask, ndev,
-                                             capacity)
-        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
-        rok = jax.lax.all_to_all(ok, axis, 0, 0, tiled=False)
-        return (recv.reshape(ndev * capacity, rows.shape[1]),
-                rok.reshape(ndev * capacity),
-                jax.lax.psum(overflow, axis))
+        planes = _build_planes(layout, datas, masks)
+        planes_in, rok, overflow = exchange_planes(planes, dest, row_mask,
+                                                   ndev, capacity, axis)
+        return planes_in, rok, jax.lax.psum(overflow, axis)
 
     spec = P(axis)
     return jax.jit(shard_map(
@@ -217,8 +268,8 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                       capacity, axis, donate)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
-    rows, ok, overflow = fn(datas, masks, None)
-    datas_out, masks_out = _from_row_words(layout, rows)
+    planes_in, ok, overflow = fn(datas, masks, None)
+    datas_out, masks_out = _from_planes(layout, list(planes_in))
     cols = [Column(dt, data=d, validity=m)
             for dt, d, m in zip(layout.schema, datas_out, masks_out)]
     out = Table(cols, table.names)
