@@ -238,6 +238,15 @@ class Column:
 
     def to_pylist(self):
         valid = self.validity_numpy()
+        if self.dtype.id == TypeId.LIST:
+            offs = np.asarray(self.offsets)
+            child = self.children[0].to_pylist()
+            return [child[offs[i]:offs[i + 1]] if valid[i] else None
+                    for i in range(self.size)]
+        if self.dtype.id == TypeId.STRUCT:
+            fields = [c.to_pylist() for c in self.children]
+            return [tuple(f[i] for f in fields) if valid[i] else None
+                    for i in range(self.size)]
         if self.dtype.is_string:
             chars = np.asarray(self.data).tobytes()
             offs = np.asarray(self.offsets)
@@ -270,8 +279,19 @@ class Column:
         if self.dtype.is_string:
             # gather on strings: recompute per-row slices host-free via lengths
             raise NotImplementedError("string gather lives in ops.strings")
+        if self.dtype.id == TypeId.LIST:
+            return self._gather_list(indices, indices_valid)
         if self.dtype.is_nested:
-            raise NotImplementedError("nested-column gather is not supported yet")
+            # STRUCT gathers field-wise
+            kids = tuple(c.gather(indices, indices_valid)
+                         for c in self.children)
+            valid = (jnp.asarray(indices) >= 0) & \
+                    (jnp.asarray(indices) < self.size)
+            if self.validity is not None:
+                valid = valid & jnp.take(self.validity, indices, mode="clip")
+            if indices_valid is not None:
+                valid = valid & indices_valid
+            return Column(self.dtype, validity=valid, children=kids)
         indices = jnp.asarray(indices)
         # cudf out_of_bounds_policy::NULLIFY: OOB indices produce null rows
         valid = (indices >= 0) & (indices < self.data.shape[0])
@@ -281,6 +301,40 @@ class Column:
         if indices_valid is not None:
             valid = valid & indices_valid
         return Column(self.dtype, data=data, validity=valid)
+
+    def _gather_list(self, indices, indices_valid=None) -> "Column":
+        """LIST row gather (host-side: ragged output shape is data-dependent,
+        so this runs outside jit — traced gathers keep lists out of plan
+        hot paths by construction)."""
+        idx = np.asarray(indices)
+        offs = np.asarray(self.offsets).astype(np.int64)
+        n = self.size
+        ok = (idx >= 0) & (idx < n)
+        if n == 0:  # every index is OOB → all-null rows
+            return Column(self.dtype,
+                          validity=jnp.zeros(len(idx), jnp.bool_),
+                          offsets=jnp.zeros(len(idx) + 1, jnp.int32),
+                          children=(self.children[0].gather(
+                              jnp.zeros(0, jnp.int64)),))
+        safe = np.clip(idx, 0, max(n - 1, 0))
+        lens = (offs[safe + 1] - offs[safe]) * ok
+        new_offs = np.zeros(len(idx) + 1, np.int64)
+        np.cumsum(lens, out=new_offs[1:])
+        child_idx = np.concatenate(
+            [np.arange(offs[s], offs[s] + ln, dtype=np.int64)
+             for s, ln in zip(safe, lens)]) if len(idx) else \
+            np.zeros(0, np.int64)
+        child = self.children[0].gather(jnp.asarray(child_idx)) \
+            if len(child_idx) else self.children[0].gather(
+                jnp.zeros(0, jnp.int64))
+        valid = ok
+        if self.validity is not None:
+            valid = valid & np.asarray(self.validity)[safe]
+        if indices_valid is not None:
+            valid = valid & np.asarray(indices_valid)
+        return Column(self.dtype, validity=jnp.asarray(valid),
+                      offsets=jnp.asarray(new_offs.astype(np.int32)),
+                      children=(child,))
 
     def with_validity(self, validity) -> "Column":
         return Column(self.dtype, self.data, validity, self.offsets, self.children)

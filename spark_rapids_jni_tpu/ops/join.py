@@ -167,12 +167,16 @@ def _probe_stage(lk: Table, rk: Table):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _expand_verify_stage(total: int, probe, lk: Table, rk: Table):
-    """Stage 2: enumerate candidate pairs + verify key equality."""
+def _expand_verify_stage(capacity: int, probe, lk: Table, rk: Table):
+    """Stage 2: enumerate candidate pairs + verify key equality.
+
+    ``capacity`` is the static pair bound — callers round the true
+    expansion up to a power of two so join cardinality (data-dependent)
+    costs at most log2 distinct XLA compilations, not one per size."""
     lh, rh, r_order, lo, offsets, starts, _ = probe
-    li, ri, _ = _expand_pairs(r_order, lo, offsets, starts,
-                              lh.shape[0], rh.shape[0], total)
-    eq = jnp.ones((total,), jnp.bool_)
+    li, ri, in_range = _expand_pairs(r_order, lo, offsets, starts,
+                                     lh.shape[0], rh.shape[0], capacity)
+    eq = in_range
     for lc, rc in zip(lk.columns, rk.columns):
         eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
     return li, ri, eq, jnp.sum(eq.astype(jnp.int64))
@@ -210,7 +214,8 @@ def _candidates(left: Table, right: Table, on_left, on_right):
             eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
         return li, ri, eq, lk, rk
 
-    li, ri, eq, _ = _expand_verify_stage(total, probe, lk, rk)
+    cap = 1 << max(4, (total - 1).bit_length())
+    li, ri, eq, _ = _expand_verify_stage(cap, probe, lk, rk)
     return li, ri, eq, lk, rk
 
 
@@ -351,9 +356,9 @@ def left_anti_join(left: Table, right: Table, on_left, on_right=None) -> Table:
 
 def _assemble(left, right, li, ri, on_left, on_right, suffixes, right_valid):
     on_r = tuple(on_right) if isinstance(on_right, (list, tuple)) else on_right
-    if any(c.dtype.is_string for c in
+    if any(c.dtype.is_string or c.dtype.is_nested for c in
            list(left.columns) + list(right.columns)):
-        # string gathers size padded matrices on the host -> eager
+        # string/nested gathers size ragged output on the host -> eager
         return _assemble_body(left, right, li, ri, on_r, tuple(suffixes),
                               right_valid)
     return _assemble_jit(left, right, li, ri, on_r, tuple(suffixes),

@@ -156,15 +156,15 @@ def test_from_pydict_jax_array_keeps_dtype():
     np.testing.assert_array_equal(t["x"].to_numpy(), [1.5, 2.5])
 
 
-def test_nested_gather_raises_not_implemented():
+def test_list_gather_and_to_pylist():
     import numpy as np
     import jax.numpy as jnp
-    import pytest
     from spark_rapids_jni_tpu.columnar import Column
     child = Column.from_numpy(np.arange(3, dtype=np.int64))
     lst = Column.list_(child, np.array([0, 1, 3], np.int32))
-    with pytest.raises(NotImplementedError):
-        lst.gather(jnp.array([0, 1]))
+    assert lst.to_pylist() == [[0], [1, 2]]
+    g = lst.gather(jnp.array([1, 0, 7]))  # OOB nullifies, cudf-style
+    assert g.to_pylist() == [[1, 2], [0], None]
 
 
 def test_float64_fixed_int_input_is_bits():

@@ -1,0 +1,272 @@
+"""ORC scan path vs a pyarrow/ORC-C++ oracle.
+
+Same discipline as test_parquet: pyarrow writes every file (no engine code
+on the write side), the engine reads it, values must match pyarrow's own
+read.  Covers the libcudf "Parquet/ORC I/O" role (SURVEY.md §2.2).
+"""
+
+import datetime
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as orc
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.io import ORCChunkedReader, ORCFile, read_orc
+
+
+def roundtrip(tmp_path, arrow_table, **kw):
+    p = tmp_path / "t.orc"
+    orc.write_table(arrow_table, p, **kw)
+    return read_orc(p)
+
+
+def assert_matches(got_table, arrow_table):
+    for name in arrow_table.column_names:
+        want = arrow_table.column(name).to_pylist()
+        got = got_table[name].to_pylist()
+        w0 = next((w for w in want if w is not None), None)
+        if isinstance(w0, float):
+            for g, w in zip(got, want):
+                assert (g is None) == (w is None)
+                if w is not None:
+                    assert g == pytest.approx(w, rel=1e-12), name
+        else:
+            assert got == want, name
+
+
+class TestScalarTypes:
+    @pytest.mark.parametrize("comp", ["uncompressed", "zlib", "snappy"])
+    def test_mixed_nullable_roundtrip(self, tmp_path, comp):
+        t = pa.table({
+            "i64": pa.array([1, 2, 3, None, 5], pa.int64()),
+            "i32": pa.array([10, None, 30, 40, 50], pa.int32()),
+            "i16": pa.array([7, -7, None, 0, 32767], pa.int16()),
+            "i8": pa.array([1, None, -128, 127, 0], pa.int8()),
+            "s": pa.array(["x", "yy", None, "zzz", ""]),
+            "f64": pa.array([1.5, 2.5, None, 4.0, -1.25], pa.float64()),
+            "f32": pa.array([0.5, None, -2.0, 3.5, 1e30], pa.float32()),
+            "b": pa.array([True, False, None, True, False]),
+        })
+        got = roundtrip(tmp_path, t, compression=comp)
+        assert_matches(got, t)
+        assert got["i64"].dtype == dt.INT64
+        assert got["i8"].dtype == dt.INT8
+        assert got["b"].dtype == dt.BOOL8
+
+    def test_all_null_and_no_null_columns(self, tmp_path):
+        t = pa.table({
+            "an": pa.array([None, None, None], pa.int64()),
+            "nn": pa.array([1, 2, 3], pa.int64()),
+        })
+        got = roundtrip(tmp_path, t)
+        assert_matches(got, t)
+
+    def test_empty_table(self, tmp_path):
+        t = pa.table({"x": pa.array([], pa.int64()),
+                      "s": pa.array([], pa.string()),
+                      "l": pa.array([], pa.list_(pa.int64())),
+                      "b": pa.array([], pa.binary())})
+        got = roundtrip(tmp_path, t)
+        assert got.num_rows == 0
+        assert list(got.names) == ["x", "s", "l", "b"]
+        assert got["l"].to_pylist() == []
+
+
+class TestIntegerRLEv2:
+    """Exercise each RLEv2 sub-encoding: the ORC-C++ writer picks
+    SHORT_REPEAT for constants, DELTA for monotone runs, DIRECT for noise,
+    PATCHED_BASE for noise with outliers."""
+
+    def test_sequential_delta(self, tmp_path):
+        t = pa.table({"x": pa.array(np.arange(50_000, dtype=np.int64))})
+        assert_matches(roundtrip(tmp_path, t, compression="zlib"), t)
+
+    def test_descending_delta(self, tmp_path):
+        t = pa.table({"x": pa.array(np.arange(50_000, 0, -1, dtype=np.int64))})
+        assert_matches(roundtrip(tmp_path, t), t)
+
+    def test_constant_short_repeat(self, tmp_path):
+        t = pa.table({"x": pa.array(np.full(10_000, -123456789, np.int64))})
+        assert_matches(roundtrip(tmp_path, t), t)
+
+    def test_random_direct(self, tmp_path):
+        rng = np.random.default_rng(0)
+        t = pa.table({"x": pa.array(rng.integers(-2**40, 2**40, 50_000))})
+        assert_matches(roundtrip(tmp_path, t, compression="snappy"), t)
+
+    def test_outliers_patched_base(self, tmp_path):
+        rng = np.random.default_rng(1)
+        vals = rng.integers(0, 100, 50_000)
+        vals[rng.integers(0, 50_000, 64)] = 2**45
+        t = pa.table({"x": pa.array(vals)})
+        assert_matches(roundtrip(tmp_path, t), t)
+
+    def test_negative_values(self, tmp_path):
+        rng = np.random.default_rng(2)
+        t = pa.table({"x": pa.array(-rng.integers(0, 2**20, 30_000))})
+        assert_matches(roundtrip(tmp_path, t), t)
+
+    def test_int64_extremes(self, tmp_path):
+        t = pa.table({"x": pa.array([2**63 - 1, -2**63, 0, -1, 1] * 100,
+                                    pa.int64())})
+        assert_matches(roundtrip(tmp_path, t), t)
+
+
+class TestStrings:
+    def test_direct_strings(self, tmp_path):
+        vals = [f"row-{i}-{'x' * (i % 13)}" for i in range(5_000)]
+        vals[17] = None
+        vals[100] = ""
+        t = pa.table({"s": pa.array(vals)})
+        assert_matches(roundtrip(tmp_path, t, compression="zlib"), t)
+
+    def test_dictionary_strings(self, tmp_path):
+        words = ["alpha", "beta", "gamma", "delta"]
+        rng = np.random.default_rng(1)
+        vals = [words[i] if i < 4 else None for i in rng.integers(0, 5, 20_000)]
+        t = pa.table({"s": pa.array(vals)})
+        got = roundtrip(tmp_path, t, compression="zlib",
+                        dictionary_key_size_threshold=1.0)
+        assert_matches(got, t)
+
+    def test_unicode(self, tmp_path):
+        t = pa.table({"s": pa.array(["héllo", "日本語", "🚀", None, "a\x00b"])})
+        assert_matches(roundtrip(tmp_path, t), t)
+
+
+class TestTemporal:
+    def test_timestamps_incl_pre_epoch(self, tmp_path):
+        ts = [datetime.datetime(2024, 7, 30, 12, 34, 56, 789123),
+              datetime.datetime(2014, 1, 1, 0, 0, 0, 500000),
+              datetime.datetime(1969, 12, 31, 23, 59, 59, 250000),
+              None,
+              datetime.datetime(1900, 6, 15, 6, 30, 0, 1),
+              datetime.datetime(2015, 1, 1)]
+        t = pa.table({"ts": pa.array(ts, pa.timestamp("us"))})
+        got = roundtrip(tmp_path, t)
+        assert got["ts"].dtype == dt.TIMESTAMP_NANOSECONDS
+        epoch = datetime.datetime(1970, 1, 1)
+        want = [None if v is None else
+                round((v - epoch).total_seconds() * 1e6) * 1000 for v in ts]
+        assert got["ts"].to_pylist() == want
+
+    def test_tz_aware_timestamp_instant(self, tmp_path):
+        micros = [1722340000000000, None, 0, -1000000, 1421000000123456]
+        t = pa.table({"tz": pa.array(micros, pa.timestamp("us", tz="UTC"))})
+        got = roundtrip(tmp_path, t)
+        want = [None if v is None else v * 1000 for v in micros]
+        assert got["tz"].to_pylist() == want
+
+    def test_dates(self, tmp_path):
+        dates = [datetime.date(2024, 7, 30), datetime.date(1969, 1, 1), None,
+                 datetime.date(1583, 1, 1), datetime.date(2100, 12, 31),
+                 datetime.date(1970, 1, 1)]
+        t = pa.table({"d": pa.array(dates, pa.date32())})
+        got = roundtrip(tmp_path, t)
+        assert got["d"].dtype == dt.TIMESTAMP_DAYS
+        epoch = datetime.date(1970, 1, 1)
+        want = [None if v is None else (v - epoch).days for v in dates]
+        assert got["d"].to_pylist() == want
+
+
+class TestDecimal:
+    def test_decimal64(self, tmp_path):
+        vals = [decimal.Decimal("123.45"), decimal.Decimal("-0.01"), None,
+                decimal.Decimal("99999.99"), decimal.Decimal("0.00")]
+        t = pa.table({"d": pa.array(vals, pa.decimal128(7, 2))})
+        got = roundtrip(tmp_path, t)
+        assert got["d"].dtype.scale == -2
+        assert got["d"].to_pylist() == vals
+
+    def test_decimal128(self, tmp_path):
+        vals = [decimal.Decimal("12345678901234567890.123"), None,
+                decimal.Decimal("-999999999999999999999.999"),
+                decimal.Decimal("0.001"), decimal.Decimal("42.000")]
+        t = pa.table({"d": pa.array(vals, pa.decimal128(24, 3))})
+        got = roundtrip(tmp_path, t)
+        assert got["d"].dtype == dt.decimal128(-3)
+        assert got["d"].to_pylist() == vals
+
+
+class TestNested:
+    def test_list_of_int(self, tmp_path):
+        vals = [[1, 2, 3], None, [], [4], [5, 6]]
+        t = pa.table({"l": pa.array(vals, pa.list_(pa.int64()))})
+        got = roundtrip(tmp_path, t)
+        assert got["l"].to_pylist() == vals
+
+    def test_list_of_string(self, tmp_path):
+        vals = [["a", "bb"], [], None, ["ccc", None, ""]]
+        t = pa.table({"l": pa.array(vals, pa.list_(pa.string()))})
+        got = roundtrip(tmp_path, t)
+        assert got["l"].to_pylist() == vals
+
+    def test_binary_as_list_u8(self, tmp_path):
+        vals = [b"ab", None, b"", b"xyz", b"\x00\xff"]
+        t = pa.table({"b": pa.array(vals, pa.binary())})
+        got = roundtrip(tmp_path, t)
+        have = [None if v is None else bytes(v) for v in
+                (None if x is None else bytearray(x)
+                 for x in got["b"].to_pylist())]
+        assert have == vals
+
+    def test_list_payload_through_join(self, tmp_path):
+        """A LIST column rides a join as payload (eager assemble path)."""
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.ops.join import inner_join
+        vals = [[1, 2], None, [3]]
+        t = pa.table({"k": pa.array([10, 20, 30], pa.int64()),
+                      "l": pa.array(vals, pa.list_(pa.int64()))})
+        left = roundtrip(tmp_path, t)
+        right = Table([Column.from_numpy(np.array([20, 30, 40], np.int64)),
+                       Column.from_numpy(np.array([7, 8, 9], np.int64))],
+                      ["k", "rv"])
+        j = inner_join(left, right, ["k"])
+        rows = sorted(zip(j["k"].to_pylist(),
+                          [tuple(x) if x is not None else None
+                           for x in j["l"].to_pylist()],
+                          j["rv"].to_pylist()))
+        assert rows == [(20, None, 7), (30, (3,), 8)]
+
+    def test_list_gather(self, tmp_path):
+        vals = [[1, 2], [3], None, [4, 5, 6], []]
+        t = pa.table({"l": pa.array(vals, pa.list_(pa.int64()))})
+        got = roundtrip(tmp_path, t)
+        g = got["l"].gather(np.array([3, 0, 99, 2]))
+        assert g.to_pylist() == [[4, 5, 6], [1, 2], None, None]
+
+
+class TestStripes:
+    def test_multi_stripe_and_chunked(self, tmp_path):
+        n = 3_000_000
+        t = pa.table({
+            "x": pa.array(np.arange(n, dtype=np.int64)),
+            "y": pa.array(np.random.default_rng(0).standard_normal(n)),
+        })
+        p = tmp_path / "big.orc"
+        orc.write_table(t, p, compression="snappy",
+                        stripe_size=4 * 1024 * 1024)
+        f = ORCFile(p)
+        assert f.num_stripes > 1
+        assert f.num_rows == n
+        got = f.read()
+        assert np.array_equal(got["x"].to_numpy(), np.arange(n))
+        assert np.allclose(got["y"].to_numpy().view(np.float64),
+                           t["y"].to_numpy())
+        total = 0
+        for chunk in ORCChunkedReader(p, columns=["x"]):
+            assert chunk.names == ("x",) or list(chunk.names) == ["x"]
+            total += chunk.num_rows
+        assert total == n
+
+    def test_column_projection(self, tmp_path):
+        t = pa.table({"a": pa.array([1, 2, 3], pa.int64()),
+                      "b": pa.array(["x", "y", "z"])})
+        got = roundtrip(tmp_path, t)
+        only_b = ORCFile(tmp_path / "t.orc").read(columns=["b"])
+        assert list(only_b.names) == ["b"]
+        assert only_b["b"].to_pylist() == ["x", "y", "z"]
+        assert_matches(got, t)
