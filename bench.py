@@ -253,6 +253,66 @@ def bench_parquet_scan(n=2_000_000):
     return decode, e2e, arrow
 
 
+def bench_distributed_join(n_left=1_000_000, n_right=250_000):
+    """Shuffle + distributed SortMergeJoin, BASELINE configs[3].
+
+    The deployment has one physical chip, so the 8-device exchange runs in
+    a subprocess on the virtual CPU mesh (the same path dryrun_multichip
+    validates); the single-chip metrics above stay on the TPU.  Reports
+    Mrows/s of left-side input through shuffle+join, and the local
+    single-device join rate on the same host for scale context.
+    """
+    import subprocess
+    import os
+    import sys as _sys
+    script = f"""
+import json, time
+import numpy as np
+import spark_rapids_jni_tpu
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.parallel import make_mesh, distributed_join
+rng = np.random.default_rng(3)
+nl, nr = {n_left}, {n_right}
+left = Table([Column.from_numpy(rng.integers(0, nr, nl).astype(np.int64)),
+              Column.from_numpy(rng.integers(-100, 100, nl).astype(np.int64))],
+             ["k", "v"])
+right = Table([Column.from_numpy(rng.permutation(nr).astype(np.int64)),
+               Column.from_numpy(np.arange(nr, dtype=np.int64))],
+              ["k", "rv"])
+mesh = make_mesh(8)
+out = distributed_join(left, right, mesh, ["k"])   # warm (compile)
+t0 = time.perf_counter(); out = distributed_join(left, right, mesh, ["k"])
+drows = out.num_rows; dt_d = time.perf_counter() - t0
+out2 = inner_join(left, right, ["k"])              # warm
+t0 = time.perf_counter(); out2 = inner_join(left, right, ["k"])
+dt_l = time.perf_counter() - t0
+assert out.num_rows == out2.num_rows
+print(json.dumps({{"dist_mrows_s": nl / dt_d / 1e6,
+                   "local_mrows_s": nl / dt_l / 1e6,
+                   "rows_out": drows}}))
+"""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"),
+               JAX_ENABLE_X64="1")
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([_sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=900)
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            print(f"distributed-join bench failed (rc={r.returncode}):\n"
+                  f"{r.stderr[-2000:]}", file=_sys.stderr)
+            return None, None
+        d = json.loads(lines[-1])
+        return d["dist_mrows_s"], d["local_mrows_s"]
+    except Exception as e:
+        print(f"distributed-join bench failed: {e!r}", file=_sys.stderr)
+        return None, None
+
+
 def main():
     import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
 
@@ -260,6 +320,7 @@ def main():
     cast_dev, cast_cpu = bench_cast_strings()
     agg_dev, agg_cpu = bench_hash_aggregate()
     scan_decode, scan_e2e, scan_arrow = bench_parquet_scan()
+    smj_dist, smj_local = bench_distributed_join()
 
     print(json.dumps({
         "metric": "row_conversion_to_rows_GBps" + ("" if ok else "_MISMATCH"),
@@ -278,6 +339,10 @@ def main():
                 "vs_pyarrow": round(scan_decode / scan_arrow, 3)},
             "parquet_scan_to_device_MBps": {
                 "value": round(scan_e2e, 1)},
+            **({"shuffle_smj_8dev_cpu_mesh_Mrows_s": {
+                "value": round(smj_dist, 2),
+                "vs_local_single_device": round(smj_dist / smj_local, 3)}}
+               if smj_dist else {}),
         },
     }))
 

@@ -282,8 +282,9 @@ class Column:
         if self.dtype.id == TypeId.LIST:
             return self._gather_list(indices, indices_valid)
         if self.dtype.is_nested:
-            # STRUCT gathers field-wise
-            kids = tuple(c.gather(indices, indices_valid)
+            # STRUCT gathers field-wise (string fields via ops.selection)
+            from ..ops.selection import gather_column
+            kids = tuple(gather_column(c, indices, indices_valid)
                          for c in self.children)
             valid = (jnp.asarray(indices) >= 0) & \
                     (jnp.asarray(indices) < self.size)
@@ -306,6 +307,7 @@ class Column:
         """LIST row gather (host-side: ragged output shape is data-dependent,
         so this runs outside jit — traced gathers keep lists out of plan
         hot paths by construction)."""
+        from ..ops.selection import gather_column
         idx = np.asarray(indices)
         offs = np.asarray(self.offsets).astype(np.int64)
         n = self.size
@@ -314,19 +316,19 @@ class Column:
             return Column(self.dtype,
                           validity=jnp.zeros(len(idx), jnp.bool_),
                           offsets=jnp.zeros(len(idx) + 1, jnp.int32),
-                          children=(self.children[0].gather(
-                              jnp.zeros(0, jnp.int64)),))
+                          children=(gather_column(
+                              self.children[0], jnp.zeros(0, jnp.int64)),))
         safe = np.clip(idx, 0, max(n - 1, 0))
         lens = (offs[safe + 1] - offs[safe]) * ok
         new_offs = np.zeros(len(idx) + 1, np.int64)
         np.cumsum(lens, out=new_offs[1:])
+        if new_offs[-1] > np.iinfo(np.int32).max:
+            raise ValueError("gathered LIST column exceeds int32 offsets")
         child_idx = np.concatenate(
             [np.arange(offs[s], offs[s] + ln, dtype=np.int64)
              for s, ln in zip(safe, lens)]) if len(idx) else \
             np.zeros(0, np.int64)
-        child = self.children[0].gather(jnp.asarray(child_idx)) \
-            if len(child_idx) else self.children[0].gather(
-                jnp.zeros(0, jnp.int64))
+        child = gather_column(self.children[0], jnp.asarray(child_idx))
         valid = ok
         if self.validity is not None:
             valid = valid & np.asarray(self.validity)[safe]

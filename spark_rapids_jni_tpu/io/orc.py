@@ -54,16 +54,7 @@ _ORC_EPOCH_S = 1420070400
 # minimal protobuf wire decoder (ORC metadata is proto2; we read by field id,
 # mirroring how io.thrift reads parquet's compact-protocol structs)
 
-def _uvarint(buf, pos):
-    result = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
+_uvarint = _snappy_py._uvarint  # one LEB128 decoder for the whole io package
 
 
 def _pb_fields(buf) -> dict:

@@ -271,11 +271,11 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
                 jax.lax.psum(lovf + rovf, axis), jax.lax.psum(jovf, axis))
 
     spec = P(axis)
-    return shard_map(
+    return jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec, spec, spec, spec, P(), P()),
-        check_vma=False)
+        check_vma=False))
 
 
 @traced("distributed_join")

@@ -231,6 +231,13 @@ class TestNested:
                           j["rv"].to_pylist()))
         assert rows == [(20, None, 7), (30, (3,), 8)]
 
+    def test_list_of_string_gather(self, tmp_path):
+        vals = [["a", "bb"], None, ["ccc", None]]
+        t = pa.table({"l": pa.array(vals, pa.list_(pa.string()))})
+        got = roundtrip(tmp_path, t)
+        g = got["l"].gather(np.array([2, 0, 5]))
+        assert g.to_pylist() == [["ccc", None], ["a", "bb"], None]
+
     def test_list_gather(self, tmp_path):
         vals = [[1, 2], [3], None, [4, 5, 6], []]
         t = pa.table({"l": pa.array(vals, pa.list_(pa.int64()))})
