@@ -17,3 +17,4 @@ from .parquet import (  # noqa: F401
 from .parquet_writer import write_parquet  # noqa: F401
 from .csv import read_csv  # noqa: F401
 from .orc import ORCChunkedReader, ORCFile, read_orc  # noqa: F401
+from .orc_writer import write_orc  # noqa: F401
