@@ -161,10 +161,23 @@ def _int96_to_ns(raw: np.ndarray) -> np.ndarray:
 @dataclass
 class ColumnSchema:
     name: str
-    physical: int
+    physical: int          # element physical type for LIST columns
     type_length: int
-    optional: bool
-    dtype: dt.DType
+    optional: bool         # element nullability for LIST columns
+    dtype: dt.DType        # element dtype for LIST columns
+    is_list: bool = False  # standard 3-level LIST<element>
+    list_optional: bool = False  # outer list group nullability
+
+    @property
+    def max_def(self) -> int:
+        if self.is_list:
+            return (1 if self.list_optional else 0) + 1 + \
+                (1 if self.optional else 0)
+        return 1 if self.optional else 0
+
+    @property
+    def max_rep(self) -> int:
+        return 1 if self.is_list else 0
 
 
 @dataclass
@@ -189,12 +202,13 @@ def _interpret_schema_element(elem: dict) -> ColumnSchema | None:
     """SchemaElement fields: 1 type, 2 type_length, 3 repetition, 4 name,
     5 num_children, 6 converted_type, 7 scale, 8 precision, 10 logicalType."""
     name = elem.get(4, b"").decode()
-    if elem.get(5):  # group node → nested schema
+    if elem.get(5):  # group node → handled by the _parse_footer tree walk
         raise NotImplementedError(
             f"nested parquet schemas are not supported (group {name!r})")
     rep = elem.get(3, 0)
-    if rep == 2:  # REPEATED
-        raise NotImplementedError(f"repeated field {name!r} (lists) unsupported")
+    if rep == 2:  # bare REPEATED leaf: legacy 2-level list, not supported
+        raise NotImplementedError(
+            f"legacy unannotated repeated field {name!r} unsupported")
     phys = elem[1]
     conv = elem.get(6)
     logical = elem.get(10) or {}
@@ -268,13 +282,44 @@ def _interpret_schema_element(elem: dict) -> ColumnSchema | None:
     return ColumnSchema(name, phys, tl, rep == 1, out)
 
 
+def _parse_list_group(elems, i: int) -> tuple[ColumnSchema, int]:
+    """Standard 3-level LIST at elems[i]: optional group (LIST) { repeated
+    group g { <element> } } → (list ColumnSchema, next index)."""
+    outer = elems[i]
+    name = outer.get(4, b"").decode()
+    if outer.get(5) != 1 or i + 2 >= len(elems):
+        raise NotImplementedError(f"unsupported LIST shape at {name!r}")
+    mid = elems[i + 1]
+    if mid.get(3, 0) != 2 or mid.get(5) != 1:
+        raise NotImplementedError(
+            f"LIST {name!r} without the standard repeated middle group")
+    elem = elems[i + 2]
+    if elem.get(5):
+        raise NotImplementedError(f"nested LIST element under {name!r}")
+    es = _interpret_schema_element(elem)
+    return ColumnSchema(name, es.physical, es.type_length,
+                        optional=es.optional, dtype=es.dtype, is_list=True,
+                        list_optional=outer.get(3, 0) == 1), i + 3
+
+
 def _parse_footer(meta: dict):
     """FileMetaData: 2 schema, 3 num_rows, 4 row_groups."""
     elems = meta[2]
-    root, leaves = elems[0], elems[1:]
-    if len(leaves) != root.get(5, 0):
-        raise NotImplementedError("nested parquet schema (group nodes present)")
-    schema = [_interpret_schema_element(e) for e in leaves]
+    root = elems[0]
+    schema = []
+    i, nchildren = 1, root.get(5, 0)
+    for _ in range(nchildren):
+        e = elems[i]
+        if e.get(5):  # group node: only the LIST pattern is supported
+            conv, logical = e.get(6), e.get(10) or {}
+            if conv == 3 or 3 in logical:  # ConvertedType/LogicalType LIST
+                cs, i = _parse_list_group(elems, i)
+                schema.append(cs)
+                continue
+            raise NotImplementedError(
+                f"nested parquet schema (group {e.get(4, b'').decode()!r})")
+        schema.append(_interpret_schema_element(e))
+        i += 1
     by_name = {s.name: i for i, s in enumerate(schema)}
     groups = []
     for rg in meta.get(4, []):
@@ -283,9 +328,11 @@ def _parse_footer(meta: dict):
         for cc in rg[1]:
             cm = cc[3]  # ColumnMetaData
             path = [p.decode() for p in cm[3]]
-            if len(path) != 1 or path[0] not in by_name:
+            if path[0] not in by_name:
                 raise NotImplementedError(f"column path {path} unsupported")
             idx = by_name[path[0]]
+            if (len(path) != 1) != schema[idx].is_list:
+                raise NotImplementedError(f"column path {path} unsupported")
             dict_off = cm.get(11)
             data_off = cm[9]
             start = data_off if dict_off is None else min(dict_off, data_off)
@@ -311,20 +358,35 @@ class _HostColumn:
     chars: np.ndarray | None       # STRING: char buffer (nulls contribute 0 B)
     offsets: np.ndarray | None     # STRING: int32[n+1]
     validity: np.ndarray | None    # bool[n] or None
+    child: "_HostColumn | None" = None   # LIST: element chunk
+    loffsets: np.ndarray | None = None   # LIST: int32[n+1] row offsets
 
     @property
     def num_rows(self):
+        if self.loffsets is not None:
+            return len(self.loffsets) - 1
         return (len(self.offsets) - 1 if self.offsets is not None
                 else len(self.values))
 
     def nbytes_estimate(self):
-        per = (self.chars.nbytes + self.offsets.nbytes
-               if self.chars is not None else self.values.nbytes)
+        if self.loffsets is not None:
+            per = self.child.nbytes_estimate() + self.loffsets.nbytes
+        else:
+            per = (self.chars.nbytes + self.offsets.nbytes
+                   if self.chars is not None else self.values.nbytes)
         if self.validity is not None:
             per += self.validity.nbytes
         return per
 
     def slice(self, a: int, b: int) -> "_HostColumn":
+        if self.loffsets is not None:
+            lo = self.loffsets[a:b + 1]
+            child = self.child.slice(int(lo[0]), int(lo[-1]))
+            return _HostColumn(self.schema, None, None, None,
+                               None if self.validity is None
+                               else self.validity[a:b],
+                               child=child,
+                               loffsets=(lo - lo[0]).astype(np.int32))
         if self.offsets is not None:
             offs = self.offsets[a:b + 1]
             chars = self.chars[offs[0]:offs[-1]]
@@ -338,6 +400,9 @@ class _HostColumn:
 
     def to_column(self) -> Column:
         s = self.schema
+        if self.loffsets is not None:
+            return Column.list_(self.child.to_column(), self.loffsets,
+                                self.validity)
         if s.dtype.is_string:
             return Column.string(self.chars, self.offsets, self.validity)
         return Column.fixed(s.dtype, self.values, self.validity)
@@ -389,6 +454,39 @@ def _gather_dict(schema: ColumnSchema, dict_vals, idx: np.ndarray):
     return dict_vals[idx]
 
 
+def _scatter_values(s: ColumnSchema, n: int, vals, mask):
+    """Scatter the non-null value stream into ``n`` slots (nulls zeroed).
+
+    ``mask`` (bool[n] or None) marks slots that carry a real value.
+    Returns the (values, chars, offsets) triple of a _HostColumn.
+    """
+    if s.physical == PT_BYTE_ARRAY:
+        chars = np.concatenate([v[0] for v in vals]) if vals else \
+            np.zeros(0, np.uint8)
+        nn_lens = np.concatenate([v[1] for v in vals]) if vals else \
+            np.zeros(0, np.int32)
+        lens = np.zeros(n, np.int64)
+        if mask is None:
+            lens[:] = nn_lens
+        else:
+            lens[mask] = nn_lens
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        if offsets[-1] > np.iinfo(np.int32).max:
+            raise ValueError("string chunk exceeds int32 offsets; "
+                             "use a smaller row-group size")
+        return None, chars, offsets.astype(np.int32)
+    storage = s.dtype.storage
+    dense = np.zeros(n, storage)
+    nn = np.concatenate([np.asarray(v, storage) for v in vals]) if vals \
+        else np.zeros(0, storage)
+    if mask is None:
+        dense[:] = nn
+    else:
+        dense[mask] = nn
+    return dense, None, None
+
+
 class _ChunkDecoder:
     """Decode one column chunk's page stream into a _HostColumn."""
 
@@ -403,7 +501,7 @@ class _ChunkDecoder:
         pos = meta.start_offset
         end = meta.start_offset + meta.total_compressed
         remaining = meta.num_values
-        defs, vals = [], []
+        reps, defs, vals = [], [], []
         while remaining > 0 and pos < end:
             header, pos = decode_struct(self.fbuf, pos)
             ptype = header[1]
@@ -415,12 +513,14 @@ class _ChunkDecoder:
                 nd = header[7][1]  # DictionaryPageHeader.num_values
                 self.dict_vals = _decode_plain(self.schema, data, nd)
             elif ptype == PAGE_DATA:
-                d, v, nv = self._data_page_v1(page, header)
+                r, d, v, nv = self._data_page_v1(page, header)
+                reps.append(r)
                 defs.append(d)
                 vals.append(v)
                 remaining -= nv
             elif ptype == PAGE_DATA_V2:
-                d, v, nv = self._data_page_v2(page, header)
+                r, d, v, nv = self._data_page_v2(page, header)
+                reps.append(r)
                 defs.append(d)
                 vals.append(v)
                 remaining -= nv
@@ -428,6 +528,8 @@ class _ChunkDecoder:
                 continue
             else:
                 raise NotImplementedError(f"page type {ptype}")
+        if self.schema.is_list:
+            return self._assemble_list(reps, defs, vals)
         return self._assemble(defs, vals)
 
     # DataPageHeader: 1 num_values, 2 encoding, 3 def-level enc, 4 rep enc
@@ -437,16 +539,26 @@ class _ChunkDecoder:
         nv = ph[1]
         enc = ph[2]
         pos = 0
+        r = None
+        if self.schema.max_rep:
+            if ph.get(4, ENC_RLE) != ENC_RLE:
+                raise NotImplementedError("non-RLE repetition levels")
+            ln = int.from_bytes(data[0:4], "little")
+            r = _rle_bitpacked_hybrid(data[4:4 + ln],
+                                      self.schema.max_rep.bit_length(), nv)
+            pos = 4 + ln
         d = None
-        if self.schema.optional:
+        md = self.schema.max_def
+        if md:
             if ph.get(3, ENC_RLE) != ENC_RLE:
                 raise NotImplementedError("non-RLE definition levels")
-            ln = int.from_bytes(data[0:4], "little")
-            d = _rle_bitpacked_hybrid(data[4:4 + ln], 1, nv)
-            pos = 4 + ln
-        nnon = nv if d is None else int((d == 1).sum())
+            ln = int.from_bytes(data[pos:pos + 4], "little")
+            d = _rle_bitpacked_hybrid(data[pos + 4:pos + 4 + ln],
+                                      md.bit_length(), nv)
+            pos += 4 + ln
+        nnon = nv if d is None else int((d == md).sum())
         v = self._values(data[pos:], enc, nnon)
-        return d, v, nv
+        return r, d, v, nv
 
     # DataPageHeaderV2: 1 num_values, 2 num_nulls, 3 num_rows, 4 encoding,
     # 5 def-levels byte len, 6 rep-levels byte len, 7 is_compressed
@@ -454,17 +566,23 @@ class _ChunkDecoder:
         ph = header[8]
         nv, nnulls, enc = ph[1], ph[2], ph[4]
         dlen, rlen = ph.get(5, 0), ph.get(6, 0)
-        if rlen:
-            raise NotImplementedError("repetition levels (nested) in V2 page")
+        # V2 layout: repetition levels first, then definition levels
+        r = None
+        if self.schema.max_rep:
+            r = _rle_bitpacked_hybrid(page[0:rlen],
+                                      self.schema.max_rep.bit_length(), nv)
         d = None
-        if self.schema.optional:
-            d = _rle_bitpacked_hybrid(page[0:dlen], 1, nv)
+        md = self.schema.max_def
+        if md:
+            d = _rle_bitpacked_hybrid(page[rlen:rlen + dlen],
+                                      md.bit_length(), nv)
         body = page[dlen + rlen:]
         if ph.get(7, True):
             body = _decompress(body, self.meta.codec,
                                header[2] - dlen - rlen)
-        v = self._values(body, enc, nv - nnulls)
-        return d, v, nv
+        nnon = (nv - nnulls) if d is None else int((d == md).sum())
+        v = self._values(body, enc, nnon)
+        return r, d, v, nv
 
     def _values(self, data: bytes, enc: int, nnon: int):
         if enc in (ENC_PLAIN_DICTIONARY, ENC_RLE_DICTIONARY):
@@ -483,6 +601,7 @@ class _ChunkDecoder:
 
     def _assemble(self, defs, vals) -> _HostColumn:
         s = self.schema
+        md = s.max_def
         nrows = sum((len(d) if d is not None else
                      (len(v[1]) if isinstance(v, tuple) else len(v)))
                     for d, v in zip(defs, vals))
@@ -490,35 +609,57 @@ class _ChunkDecoder:
             valid = None
         else:
             valid = np.concatenate(
-                [d == 1 if d is not None else
+                [d == md if d is not None else
                  np.ones(len(v[1]) if isinstance(v, tuple) else len(v),
                          np.bool_)
                  for d, v in zip(defs, vals)])
-        if s.physical == PT_BYTE_ARRAY:
-            chars = np.concatenate([v[0] for v in vals]) if vals else \
-                np.zeros(0, np.uint8)
-            lens = np.zeros(nrows, np.int64)
-            nn_lens = np.concatenate([v[1] for v in vals]) if vals else \
-                np.zeros(0, np.int32)
-            if valid is None:
-                lens[:] = nn_lens
-            else:
-                lens[valid] = nn_lens
-            offsets = np.zeros(nrows + 1, np.int64)
-            np.cumsum(lens, out=offsets[1:])
-            if offsets[-1] > np.iinfo(np.int32).max:
-                raise ValueError("string chunk exceeds int32 offsets; "
-                                 "use a smaller row-group size")
-            return _HostColumn(s, None, chars, offsets.astype(np.int32), valid)
-        storage = s.dtype.storage
-        dense = np.zeros(nrows, storage)
-        nn = np.concatenate([np.asarray(v, storage) for v in vals]) if vals \
-            else np.zeros(0, storage)
-        if valid is None:
-            dense[:] = nn
-        else:
-            dense[valid] = nn
-        return _HostColumn(s, dense, None, None, valid)
+        values, chars, offsets = _scatter_values(s, nrows, vals, valid)
+        return _HostColumn(s, values, chars, offsets, valid)
+
+    def _assemble_list(self, reps, defs, vals) -> _HostColumn:
+        """Reconstruct LIST<element> rows from rep/def level streams.
+
+        Level semantics for the standard 3-level shape (max_def = md):
+        rep 0 starts a row; def >= elem-slot level means an element slot
+        exists (null element iff def < md); lower defs encode an empty list
+        or a null row.
+        """
+        s = self.schema
+        md = s.max_def
+        slot_def = md - (1 if s.optional else 0)
+        rep = np.concatenate([r for r in reps]) if reps else \
+            np.zeros(0, np.int32)
+        deff = np.concatenate([d for d in defs]) if defs else \
+            np.zeros(0, np.int32)
+        starts = np.flatnonzero(rep == 0)
+        nrows = len(starts)
+        row_valid = None
+        if s.list_optional:
+            row_valid = deff[starts] >= 1
+            if bool(row_valid.all()):
+                row_valid = None
+        slot = deff >= slot_def
+        cum = np.concatenate(([0], np.cumsum(slot.astype(np.int64))))
+        seg_end = np.concatenate((starts[1:], [len(rep)])) if nrows else \
+            np.zeros(0, np.int64)
+        lengths = cum[seg_end] - cum[starts]
+        loffsets = np.zeros(nrows + 1, np.int64)
+        np.cumsum(lengths, out=loffsets[1:])
+        if loffsets[-1] > np.iinfo(np.int32).max:
+            raise ValueError("list chunk exceeds int32 offsets; "
+                             "use a smaller row-group size")
+        nslots = int(loffsets[-1])
+        elem_valid = None
+        if s.optional:
+            elem_valid = (deff == md)[slot]
+            if bool(elem_valid.all()):
+                elem_valid = None
+        ecs = ColumnSchema(s.name + ".element", s.physical, s.type_length,
+                           optional=s.optional, dtype=s.dtype)
+        values, chars, offsets = _scatter_values(s, nslots, vals, elem_valid)
+        child = _HostColumn(ecs, values, chars, offsets, elem_valid)
+        return _HostColumn(s, None, None, None, row_valid, child=child,
+                           loffsets=loffsets.astype(np.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -590,13 +731,8 @@ class ParquetFile:
         hosts = [self._decode_group(gi, columns)
                  for gi in range(self.num_row_groups)]
         if not hosts:  # valid file, zero row groups (empty partition)
-            empty = [_HostColumn(
-                self.schema[i], None,
-                np.zeros(0, np.uint8), np.zeros(1, np.int32),
-                None) if self.schema[i].dtype.is_string else _HostColumn(
-                self.schema[i], np.zeros(0, self.schema[i].dtype.storage),
-                None, None, None)
-                for i in self._column_indices(columns)]
+            empty = [_empty_host(self.schema[i])
+                     for i in self._column_indices(columns)]
             return Table([h.to_column() for h in empty],
                          [h.schema.name for h in empty])
         if len(hosts) == 1:
@@ -608,6 +744,19 @@ class ParquetFile:
                      [h.schema.name for h in merged])
 
 
+def _empty_host(s: ColumnSchema) -> _HostColumn:
+    if s.is_list:
+        ecs = ColumnSchema(s.name + ".element", s.physical, s.type_length,
+                           optional=s.optional, dtype=s.dtype)
+        return _HostColumn(s, None, None, None, None,
+                           child=_empty_host(ecs),
+                           loffsets=np.zeros(1, np.int32))
+    if s.dtype.is_string:
+        return _HostColumn(s, None, np.zeros(0, np.uint8),
+                           np.zeros(1, np.int32), None)
+    return _HostColumn(s, np.zeros(0, s.dtype.storage), None, None, None)
+
+
 def _concat_host(parts: list[_HostColumn]) -> _HostColumn:
     s = parts[0].schema
     has_valid = any(p.validity is not None for p in parts)
@@ -615,6 +764,18 @@ def _concat_host(parts: list[_HostColumn]) -> _HostColumn:
         [p.validity if p.validity is not None
          else np.ones(p.num_rows, np.bool_) for p in parts]) \
         if has_valid else None
+    if s.is_list:
+        offs = [parts[0].loffsets.astype(np.int64)]
+        base = int(parts[0].loffsets[-1])
+        for p in parts[1:]:
+            offs.append(p.loffsets[1:].astype(np.int64) + base)
+            base += int(p.loffsets[-1])
+        loffsets = np.concatenate(offs)
+        if loffsets[-1] > np.iinfo(np.int32).max:
+            raise ValueError("concatenated list column exceeds int32 offsets")
+        child = _concat_host([p.child for p in parts])
+        return _HostColumn(s, None, None, None, valid, child=child,
+                           loffsets=loffsets.astype(np.int32))
     if s.dtype.is_string:
         chars = np.concatenate([p.chars for p in parts])
         offs = [parts[0].offsets.astype(np.int64)]

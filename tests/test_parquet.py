@@ -319,3 +319,49 @@ class TestPrefetch:
         p.write_bytes(bytes(raw))
         with pytest.raises(Exception):
             list(ParquetChunkedReader(p, prefetch=2))
+
+
+class TestListColumns:
+    """Standard 3-level LIST<element> (rep/def level reconstruction)."""
+
+    CASES = [[1, 2], None, [], [3], [4, 5, 6]]
+    STR_CASES = [["a"], [], None, ["b", None], ["", "cc"]]
+
+    def test_list_roundtrip_v1(self, tmp_path):
+        t = pa.table({"l": pa.array(self.CASES, pa.list_(pa.int64())),
+                      "s": pa.array(self.STR_CASES, pa.list_(pa.string())),
+                      "x": pa.array(range(5), pa.int64())})
+        got = roundtrip(tmp_path, t)
+        assert got["l"].to_pylist() == self.CASES
+        assert got["s"].to_pylist() == self.STR_CASES
+        assert got["x"].to_pylist() == list(range(5))
+
+    @pytest.mark.parametrize("kw", [
+        dict(row_group_size=3000, compression="snappy"),
+        dict(data_page_version="2.0", compression="snappy"),
+        dict(use_dictionary=False),
+    ])
+    def test_list_large(self, tmp_path, kw):
+        rng = np.random.default_rng(5)
+        n = 20_000
+        lens = rng.integers(0, 6, n)
+        vals = rng.integers(0, 50, int(lens.sum()))
+        offs = np.concatenate([[0], np.cumsum(lens)])
+        pyl = [vals[offs[i]:offs[i + 1]].tolist()
+               if rng.random() > 0.1 else None for i in range(n)]
+        t = pa.table({"l": pa.array(pyl, pa.list_(pa.int64()))})
+        got = roundtrip(tmp_path, t, **kw)
+        assert got["l"].to_pylist() == pyl
+
+    def test_list_chunked_slicing(self, tmp_path):
+        rng = np.random.default_rng(6)
+        n = 10_000
+        pyl = [list(range(int(rng.integers(0, 4)))) for _ in range(n)]
+        t = pa.table({"l": pa.array(pyl, pa.list_(pa.int64())),
+                      "x": pa.array(range(n), pa.int64())})
+        p = tmp_path / "t.parquet"
+        pq.write_table(t, p, row_group_size=2_000)
+        out = []
+        for chunk in ParquetChunkedReader(p, pass_read_limit=50_000):
+            out.extend(chunk["l"].to_pylist())
+        assert out == pyl
