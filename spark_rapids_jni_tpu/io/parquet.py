@@ -728,8 +728,19 @@ class ParquetFile:
                      [h.schema.name for h in cols])
 
     def read(self, columns=None) -> Table:
-        hosts = [self._decode_group(gi, columns)
-                 for gi in range(self.num_row_groups)]
+        if self.num_row_groups > 1:
+            # row groups are independent; numpy's decode kernels drop the
+            # GIL, so a thread pool overlaps them (libcudf's reader decodes
+            # row groups concurrently on-device for the same reason)
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=min(
+                    self.num_row_groups, os.cpu_count() or 4)) as ex:
+                hosts = list(ex.map(
+                    lambda gi: self._decode_group(gi, columns),
+                    range(self.num_row_groups)))
+        else:
+            hosts = [self._decode_group(gi, columns)
+                     for gi in range(self.num_row_groups)]
         if not hosts:  # valid file, zero row groups (empty partition)
             empty = [_empty_host(self.schema[i])
                      for i in self._column_indices(columns)]

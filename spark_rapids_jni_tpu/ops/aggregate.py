@@ -37,7 +37,8 @@ from .selection import gather_table
 from . import order as _order
 from ..utils.tracing import traced
 
-AGGS = ("sum", "min", "max", "mean", "count", "count_all")
+AGGS = ("sum", "min", "max", "mean", "count", "count_all", "var", "std",
+        "sumsq", "fsum")
 
 
 # ---------------------------------------------------------------------------
@@ -89,6 +90,16 @@ def _sum_dtype_and_vals(col: Column, sval, svalid):
         return jnp.asarray(sval, jnp.float64), FLOAT64, True
     out = col.dtype if col.dtype.is_decimal else INT64
     return sval.astype(jnp.int64), out, False
+
+
+def _float64_vals(col: Column, sval) -> jnp.ndarray:
+    """float64 value vector (Spark casts var/std inputs to double)."""
+    tid = col.dtype.id
+    if tid == TypeId.FLOAT64:
+        return Column(col.dtype, data=sval).float_values()
+    if col.dtype.is_decimal:
+        return sval.astype(jnp.float64) * (10.0 ** col.dtype.scale)
+    return jnp.asarray(sval, jnp.float64)
 
 
 def _fast_groupby_padded(key_cols, agg_specs, row_mask):
@@ -209,6 +220,23 @@ def _fast_groupby_padded(key_cols, agg_specs, row_mask):
                 plans.append((op + "_psb", col, add_start_payload(ps - m),
                               (count_slot, cgrand, ps[-1]), out_dtype, None))
             continue
+        if op in ("var", "std", "sumsq", "fsum"):
+            vf = _float64_vals(col, sval)
+            zero = jnp.zeros((), jnp.float64)
+            if op in ("var", "std"):
+                # shift by each segment's first value before accumulating
+                # moments (variance is shift-invariant; the naive two-moment
+                # formula cancels catastrophically when |mean| >> std).
+                # forward-fill-first is the same doubling scan with a
+                # leftmost-wins combiner — still gather-free.
+                pivot = _seg_scan(vf, seg, lambda cur, prev: prev, zero)
+                vf = vf - pivot
+            m = jnp.where(svalid, vf, zero)
+            s_slot = add_end_payload(_seg_scan(m, seg, jnp.add, zero))
+            q_slot = add_end_payload(_seg_scan(m * m, seg, jnp.add, zero))
+            plans.append(("var_scan", col, (s_slot, q_slot),
+                          (count_slot, cgrand, op), FLOAT64, None))
+            continue
         if op in ("min", "max"):
             tid = col.dtype.id
             if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
@@ -281,6 +309,24 @@ def _fast_groupby_padded(key_cols, agg_specs, row_mask):
                 out_aggs.append(Column.fixed(FLOAT64, m, validity=has_any))
             else:
                 out_aggs.append(Column.fixed(FLOAT64, s, validity=has_any))
+            continue
+        if kind == "var_scan":
+            s_slot, q_slot = slot
+            count_slot, cgrand, op = extra
+            counts = psb_total(count_slot, cgrand)
+            s = comp_e[s_slot]
+            q = comp_e[q_slot]
+            if op in ("sumsq", "fsum"):
+                out_aggs.append(Column.fixed(
+                    FLOAT64, q if op == "sumsq" else s,
+                    validity=counts > 0))
+                continue
+            nf = counts.astype(jnp.float64)
+            var = (q - s * s / jnp.maximum(nf, 1.0)) / \
+                jnp.maximum(nf - 1.0, 1.0)
+            var = jnp.maximum(var, 0.0)  # clamp catastrophic cancellation
+            data = jnp.sqrt(var) if op == "std" else var
+            out_aggs.append(Column.fixed(FLOAT64, data, validity=counts > 1))
             continue
         if kind == "minmax":
             count_slot, cgrand, op = extra
@@ -383,6 +429,26 @@ def _agg_column(col: Column, op: str, order, seg, num_segments: int,
             return Column.fixed(FLOAT64, s, validity=has_any)
         out_dtype = col.dtype if col.dtype.is_decimal else INT64
         return Column(out_dtype, data=s, validity=has_any)
+
+    if op in ("var", "std", "sumsq", "fsum"):
+        vf = _float64_vals(col, sval)
+        if op in ("var", "std"):
+            # shift by the segment's first value (variance is
+            # shift-invariant; the naive formula cancels when |mean| >> std)
+            first_idx = jax.ops.segment_min(
+                jnp.arange(vf.shape[0], dtype=jnp.int32), seg, num_segments)
+            pivot = jnp.take(vf, jnp.clip(first_idx, 0, vf.shape[0] - 1))
+            vf = vf - jnp.take(pivot, seg)
+        s = _segment_reduce("sum", vf, seg, num_segments, svalid)
+        q = _segment_reduce("sum", vf * vf, seg, num_segments, svalid)
+        if op in ("sumsq", "fsum"):
+            return Column.fixed(FLOAT64, q if op == "sumsq" else s,
+                                validity=has_any)
+        nf = counts.astype(jnp.float64)
+        var = (q - s * s / jnp.maximum(nf, 1.0)) / jnp.maximum(nf - 1.0, 1.0)
+        var = jnp.maximum(var, 0.0)
+        data = jnp.sqrt(var) if op == "std" else var
+        return Column.fixed(FLOAT64, data, validity=counts > 1)
 
     if op in ("min", "max"):
         if tid in (TypeId.FLOAT32, TypeId.FLOAT64):

@@ -39,20 +39,29 @@ from .shuffle import (partition_ids, cap_bucket, exchange_planes,
 
 # (partial op emitted by the local pass, final re-aggregation op)
 _REAGG = {"sum": "sum", "count": "sum", "count_all": "sum",
-          "min": "min", "max": "max"}
+          "min": "min", "max": "max", "sumsq": "sum", "fsum": "sum"}
 
 
 def _expand_aggs(aggs):
-    """mean decomposes into (sum, count) partials + a final divide."""
+    """mean decomposes into (sum, count) partials + a final divide;
+    var/std into (fsum, sumsq, count) partials + a final moment combine."""
     partial_specs = []   # (col_ref, op) for the local pass
-    final_plan = []      # ("direct", partial_idx, final_op) | ("mean", si, ci)
-    for ref, op in aggs:
+    final_plan = []      # ("direct", i, op) | ("mean", si, ci)
+    for ref, op in aggs:  # | ("var"/"std", si, qi, ci)
         if op == "mean":
             si = len(partial_specs)
             partial_specs.append((ref, "sum"))
             ci = len(partial_specs)
             partial_specs.append((ref, "count"))
             final_plan.append(("mean", si, ci))
+        elif op in ("var", "std"):
+            si = len(partial_specs)
+            partial_specs.append((ref, "fsum"))
+            qi = len(partial_specs)
+            partial_specs.append((ref, "sumsq"))
+            ci = len(partial_specs)
+            partial_specs.append((ref, "count"))
+            final_plan.append((op, si, qi, ci))
         else:
             i = len(partial_specs)
             partial_specs.append((ref, op))
@@ -95,6 +104,13 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
     """
     ndev = mesh.shape[axis]
     partial_specs, final_plan = _expand_aggs(aggs)
+    # var/std moment partials are computed over globally mean-shifted values
+    # (variance is shift-invariant; without the shift the (Σx², Σx) combine
+    # cancels catastrophically when |mean| >> std, e.g. timestamp columns)
+    shift_idx = set()
+    for plan in final_plan:
+        if plan[0] in ("var", "std"):
+            shift_idx.update((plan[1], plan[2]))
 
     def shard_fn(datas, masks, n_valid=None):
         shard_tbl = Table([Column(dt, data=d, validity=m)
@@ -110,10 +126,27 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
             global_row = shard_idx * n_local + jnp.arange(n_local,
                                                           dtype=jnp.int64)
             row_mask = global_row < n_valid
+        specs = list(partial_specs)
+        if shift_idx:
+            from ..ops.aggregate import _float64_vals
+            live = row_mask if row_mask is not None \
+                else jnp.ones((n_local,), jnp.bool_)
+            shifted = {}
+            for i in shift_idx:
+                ref = partial_specs[i][0]
+                if ref not in shifted:
+                    c = shard_tbl.column(ref)
+                    vf = _float64_vals(c, c.data)
+                    ok = c.valid_mask() & live
+                    gs = jax.lax.psum(jnp.sum(jnp.where(ok, vf, 0.0)), axis)
+                    gc = jax.lax.psum(jnp.sum(ok.astype(jnp.int64)), axis)
+                    gm = gs / jnp.maximum(gc, 1).astype(jnp.float64)
+                    shifted[ref] = Column.fixed(FLOAT64, vf - gm,
+                                                validity=c.validity)
+                specs[i] = (shifted[ref], partial_specs[i][1])
         # 1. local partial aggregation (padded to shard rows)
         out_keys, out_aggs, ng_local = groupby_padded(
-            shard_tbl, list(key_names), list(partial_specs),
-            row_mask=row_mask)
+            shard_tbl, list(key_names), specs, row_mask=row_mask)
         live_local = jnp.arange(n_local, dtype=jnp.int32) < ng_local
 
         partial_tbl = _padded_table(out_keys, out_aggs, key_names)
@@ -138,6 +171,10 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
             if plan[0] == "mean":
                 final_specs.append((f"agg{plan[1]}", "sum"))
                 final_specs.append((f"agg{plan[2]}", "sum"))
+            elif plan[0] in ("var", "std"):
+                final_specs.append((f"agg{plan[1]}", "sum"))
+                final_specs.append((f"agg{plan[2]}", "sum"))
+                final_specs.append((f"agg{plan[3]}", "sum"))
             else:
                 final_specs.append((f"agg{plan[1]}", plan[2]))
         fkeys, faggs, ng = groupby_padded(rtbl, list(key_names), final_specs,
@@ -156,6 +193,17 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
                 valid = (c.data > 0) if s.validity is None \
                     else (s.validity & (c.data > 0))
                 out_cols.append(Column.fixed(FLOAT64, m, validity=valid))
+            elif plan[0] in ("var", "std"):
+                s, q, c = faggs[fi], faggs[fi + 1], faggs[fi + 2]
+                fi += 3
+                sv = s.float_values()
+                qv = q.float_values()
+                nf = jnp.maximum(c.data, 1).astype(jnp.float64)
+                var = jnp.maximum(
+                    (qv - sv * sv / nf) / jnp.maximum(nf - 1.0, 1.0), 0.0)
+                data = jnp.sqrt(var) if plan[0] == "std" else var
+                out_cols.append(Column.fixed(FLOAT64, data,
+                                             validity=c.data > 1))
             else:
                 out_cols.append(faggs[fi])
                 fi += 1
@@ -398,7 +446,7 @@ def agg_out_dtype(col_dtype: DType, op: str) -> DType:
     """Result dtype of an aggregation (mirrors ops.aggregate._agg_column)."""
     if op in ("count", "count_all"):
         return INT64
-    if op == "mean":
+    if op in ("mean", "var", "std", "sumsq", "fsum"):
         return FLOAT64
     if op in ("min", "max"):
         return col_dtype

@@ -63,14 +63,17 @@ def _f64_to_bits_arith(x: jnp.ndarray) -> jnp.ndarray:
     c = m >= 1.0
     m = jnp.where(c, m * 0.5, m)
     e = jnp.where(c, e + 1, e)
-    # mantissa: (2m - 1) * 2^52 is exact (m carries <= 53 significant bits)
-    mant = ((m * 2.0 - 1.0) * _TWO52).astype(jnp.uint64)
+    # mantissa: (2m - 1) * 2^52 is exact (m carries <= 53 significant bits).
+    # clamp before the uint cast: for x == 0 the ladder leaves m == 0, and
+    # float->uint64 of the resulting -2^52 wraps to 0xFFF0000000000000 on TPU
+    mant = (jnp.maximum(m * 2.0 - 1.0, 0.0) * _TWO52).astype(jnp.uint64)
     bexp = jnp.clip(e + 1022, 0, 2046).astype(jnp.uint64)
     bits = (bexp << jnp.uint64(52)) | mant
     # below the normal range: DAZ semantics, flush to zero (see module doc).
-    # The comparison is true for subnormal ax whether or not the compare itself
-    # flushes, so the ladder's garbage on flushed intermediates never escapes.
-    bits = jnp.where(ax < 2.0**-1022, jnp.uint64(0), bits)
+    # The explicit == 0 term does not rely on the 2^-1022 constant surviving
+    # the backend's f64 emulation; the threshold term catches true subnormals
+    # whether or not the compare itself flushes.
+    bits = jnp.where((ax == 0.0) | (ax < 2.0**-1022), jnp.uint64(0), bits)
     bits = jnp.where(jnp.isinf(x), _INF_BITS, bits)
     return jnp.where(jnp.isnan(x), _CANON_NAN, sign | bits)
 

@@ -72,3 +72,16 @@ def test_u32_pair_roundtrip():
     np.testing.assert_array_equal(back, SPECIALS)
     np.testing.assert_array_equal(np.asarray(lo), SPECIALS.view(np.uint32)[0::2])
     np.testing.assert_array_equal(np.asarray(hi), SPECIALS.view(np.uint32)[1::2])
+
+
+def test_arith_path_exact_zero_bits():
+    """x == +/-0.0 must encode to the signed-zero patterns: the ladder
+    leaves m == 0 and the raw mantissa term would wrap to 0xFFF0... on
+    backends where float->uint64 of a negative wraps (TPU)."""
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.utils.floatbits import _f64_to_bits_arith
+    got = _f64_to_bits_arith(jnp.array([0.0, -0.0, 1.0, -1.0], jnp.float64))
+    assert int(got[0]) == 0
+    assert int(got[1]) == 0x8000000000000000
+    assert int(got[2]) == 0x3FF0000000000000
+    assert int(got[3]) == 0xBFF0000000000000

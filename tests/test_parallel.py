@@ -419,3 +419,21 @@ def test_shuffle_with_donation(mesh):
         return sorted(zip(np.asarray(out["k"].data)[okn].tolist(),
                           np.asarray(out["v"].data)[okn].tolist()))
     assert rows(out1, ok1) == rows(out2, ok2)
+
+
+def test_distributed_groupby_var_std(mesh):
+    import pandas as pd
+    rng = np.random.default_rng(3)
+    n = 8 * 64
+    k = rng.integers(0, 11, n).astype(np.int64)
+    v = rng.standard_normal(n)
+    t = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+    st = shard_table(t, mesh)
+    got = distributed_groupby(st, mesh, ["k"], [("v", "var"), ("v", "std")])
+    o = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].agg(["var", "std"])
+    d = {kk: (a, b) for kk, a, b in zip(got["k"].to_pylist(),
+                                        got.columns[1].to_pylist(),
+                                        got.columns[2].to_pylist())}
+    for kk in o.index:
+        assert abs(d[kk][0] - o.loc[kk, "var"]) < 1e-9
+        assert abs(d[kk][1] - o.loc[kk, "std"]) < 1e-9

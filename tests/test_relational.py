@@ -378,3 +378,55 @@ def test_concat_padded_under_jit():
     got = from_padded_bytes(np.asarray(out), np.asarray(lens),
                             np.asarray(valid)).to_pylist()
     assert got == ["ab1", "22", None, None]
+
+
+def test_groupby_var_std_matches_pandas():
+    import pandas as pd
+    rng = np.random.default_rng(0)
+    n = 10_000
+    k = rng.integers(0, 37, n)
+    v = rng.standard_normal(n) * 10
+    valid = rng.random(n) > 0.15
+    t = Table([Column.from_numpy(k), Column.from_numpy(v, validity=valid)],
+              ["k", "v"])
+    g = groupby(t, ["k"], [("v", "var"), ("v", "std"), ("v", "mean")],
+                names=["var", "std", "mean"])
+    df = pd.DataFrame({"k": k, "v": np.where(valid, v, np.nan)})
+    o = df.groupby("k")["v"].agg(["var", "std", "mean"])
+    gk = np.array(g["k"].to_numpy())
+    order = np.argsort(gk)
+    for nm in ["var", "std", "mean"]:
+        got = np.array([x if x is not None else np.nan
+                        for x in g[nm].to_pylist()])[order]
+        assert np.allclose(got, o[nm].to_numpy(), equal_nan=True, rtol=1e-9)
+
+
+def test_groupby_var_singleton_group_is_null():
+    t = Table([Column.from_numpy(np.array([5], np.int64)),
+               Column.from_numpy(np.array([2.0]))], ["k", "v"])
+    g = groupby(t, ["k"], [("v", "var"), ("v", "std")], names=["var", "std"])
+    assert g["var"].to_pylist() == [None]
+    assert g["std"].to_pylist() == [None]
+
+
+def test_groupby_var_zero_variance_and_big_mean():
+    """Zero-variance groups return exactly 0.0 (not -inf via the floatbits
+    zero-encoding path) and |mean| >> std does not cancel to 0."""
+    import pandas as pd
+    t = Table([Column.from_numpy(np.array([1, 1, 2, 2], np.int64)),
+               Column.from_numpy(np.array([5.0, 5.0, 3.0, 4.0]))],
+              ["k", "v"])
+    g = groupby(t, ["k"], [("v", "var")], names=["var"])
+    d = dict(zip(g["k"].to_pylist(), g["var"].to_pylist()))
+    assert d[1] == 0.0 and abs(d[2] - 0.5) < 1e-12
+
+    rng = np.random.default_rng(1)
+    n = 1000
+    v = 1e8 + rng.standard_normal(n)
+    k = rng.integers(0, 3, n)
+    t2 = Table([Column.from_numpy(k), Column.from_numpy(v)], ["k", "v"])
+    g2 = groupby(t2, ["k"], [("v", "var")], names=["var"])
+    o = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].var()
+    gk = np.array(g2["k"].to_numpy())
+    got = np.array(g2["var"].to_pylist(), float)[np.argsort(gk)]
+    assert np.allclose(got, o.to_numpy(), rtol=1e-6)
