@@ -38,7 +38,11 @@ from . import order as _order
 from ..utils.tracing import traced
 
 AGGS = ("sum", "min", "max", "mean", "count", "count_all", "var", "std",
-        "sumsq", "fsum")
+        "sumsq", "fsum", "first", "last", "collect_list")
+
+# ops the sort-carried fast path implements; first/last need positional
+# selection and collect_list is ragged (host-compacted in ``groupby``)
+_FAST_OPS = frozenset(AGGS) - {"first", "last", "collect_list"}
 
 
 # ---------------------------------------------------------------------------
@@ -69,6 +73,25 @@ def _seg_scan(vals, seg, op, identity):
         pv = _shift_down(vals, shift, identity)
         ps = _shift_down(seg, shift, jnp.int32(-1))
         vals = jnp.where(ps == seg, op(vals, pv), vals)
+        shift *= 2
+    return vals
+
+
+def _seg_first_valid(vals, has, seg):
+    """Forward-fill each segment's first VALID value (doubling passes).
+
+    Rows before any valid value keep their own payload; callers mask those
+    rows out anyway.  Gather-free, like _seg_scan."""
+    n = vals.shape[0]
+    shift = 1
+    while shift < n:
+        pv = _shift_down(vals, shift, jnp.zeros((), vals.dtype))
+        ph = _shift_down(has, shift, jnp.zeros((), jnp.bool_))
+        ps = _shift_down(seg, shift, jnp.int32(-1))
+        same = ps == seg
+        take_prev = same & ph  # an earlier valid value wins
+        vals = jnp.where(take_prev, pv, vals)
+        has = jnp.where(same, has | ph, has)
         shift *= 2
     return vals
 
@@ -224,13 +247,14 @@ def _fast_groupby_padded(key_cols, agg_specs, row_mask):
             vf = _float64_vals(col, sval)
             zero = jnp.zeros((), jnp.float64)
             if op in ("var", "std"):
-                # shift by each segment's first value before accumulating
-                # moments (variance is shift-invariant; the naive two-moment
-                # formula cancels catastrophically when |mean| >> std).
-                # forward-fill-first is the same doubling scan with a
-                # leftmost-wins combiner — still gather-free.
-                pivot = _seg_scan(vf, seg, lambda cur, prev: prev, zero)
-                vf = vf - pivot
+                # shift by each segment's first VALID value before
+                # accumulating moments (variance is shift-invariant; the
+                # naive two-moment formula cancels catastrophically when
+                # |mean| >> std).  Null-slot payloads are arbitrary (NaN,
+                # garbage), so the pivot must come from a valid row.
+                pivot = _seg_first_valid(jnp.where(svalid, vf, zero),
+                                         svalid, seg)
+                vf = jnp.where(svalid, vf - pivot, zero)
             m = jnp.where(svalid, vf, zero)
             s_slot = add_end_payload(_seg_scan(m, seg, jnp.add, zero))
             q_slot = add_end_payload(_seg_scan(m * m, seg, jnp.add, zero))
@@ -430,15 +454,38 @@ def _agg_column(col: Column, op: str, order, seg, num_segments: int,
         out_dtype = col.dtype if col.dtype.is_decimal else INT64
         return Column(out_dtype, data=s, validity=has_any)
 
+    if op in ("first", "last"):
+        # Spark first/last (ignoreNulls=False): the value at the group's
+        # first/last live row in input order (the key sort is stable)
+        n = sval.shape[0]
+        idxv = jnp.arange(n, dtype=jnp.int32)
+        live = jnp.ones((n,), jnp.bool_) if live_sorted is None \
+            else live_sorted
+        if op == "first":
+            pos = jax.ops.segment_min(jnp.where(live, idxv, n),
+                                      seg, num_segments)
+        else:
+            pos = jax.ops.segment_max(jnp.where(live, idxv, -1),
+                                      seg, num_segments)
+        has_row = (pos >= 0) & (pos < n)
+        pos_c = jnp.clip(pos, 0, max(n - 1, 0))
+        data = jnp.take(sval, pos_c, axis=0)
+        valid = jnp.take(col.valid_mask(), jnp.take(order, pos_c)) & has_row
+        return Column(col.dtype, data=data, validity=valid)
+
     if op in ("var", "std", "sumsq", "fsum"):
         vf = _float64_vals(col, sval)
         if op in ("var", "std"):
-            # shift by the segment's first value (variance is
-            # shift-invariant; the naive formula cancels when |mean| >> std)
+            # shift by the segment's first VALID value (variance is
+            # shift-invariant; the naive formula cancels when |mean| >> std;
+            # null-slot payloads are arbitrary and must not leak in)
+            n_ = vf.shape[0]
             first_idx = jax.ops.segment_min(
-                jnp.arange(vf.shape[0], dtype=jnp.int32), seg, num_segments)
-            pivot = jnp.take(vf, jnp.clip(first_idx, 0, vf.shape[0] - 1))
-            vf = vf - jnp.take(pivot, seg)
+                jnp.where(svalid, jnp.arange(n_, dtype=jnp.int32),
+                          jnp.int32(n_)), seg, num_segments)
+            pivot = jnp.take(jnp.where(svalid, vf, 0.0),
+                             jnp.clip(first_idx, 0, max(n_ - 1, 0)))
+            vf = jnp.where(svalid, vf - jnp.take(pivot, seg), 0.0)
         s = _segment_reduce("sum", vf, seg, num_segments, svalid)
         q = _segment_reduce("sum", vf * vf, seg, num_segments, svalid)
         if op in ("sumsq", "fsum"):
@@ -463,6 +510,9 @@ def _agg_column(col: Column, op: str, order, seg, num_segments: int,
         red = _segment_reduce(op, sval, seg, num_segments, svalid)
         return Column(col.dtype, data=red, validity=has_any)
 
+    if op == "collect_list":
+        raise ValueError("collect_list output is ragged; it is only "
+                         "available through ops.aggregate.groupby")
     raise ValueError(f"unknown aggregation {op!r}; expected one of {AGGS}")
 
 
@@ -485,7 +535,8 @@ def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
     agg_inputs = [c for c, _ in resolved if c is not None]
     if key_cols and key_cols[0].data is not None \
             and key_cols[0].data.shape[0] > 0 \
-            and _fast_eligible(key_cols, agg_inputs):
+            and _fast_eligible(key_cols, agg_inputs) \
+            and all(op in _FAST_OPS for _, op in resolved):
         return _fast_groupby_padded(key_cols, resolved, row_mask)
 
     skeys = [SortKey(c) for c in key_cols]
@@ -539,20 +590,91 @@ def _groupby_compiled(table: Table, key_names: tuple, aggs: tuple):
     return key_cols, out_aggs, ngroups
 
 
+def _groupby_with_collect(table: Table, key_names: list, aggs: list,
+                          names: list | None) -> Table:
+    """groupby with collect_list aggs: ragged output, host-compacted.
+
+    Scalar aggs run through the normal device path; the list columns are
+    built host-side over the same sorted-key segmentation, so group order
+    matches (both orders are ascending in the encoded key words).  Spark
+    semantics: null elements are dropped; empty groups give [] not null.
+    """
+    others = [(r, op) for r, op in aggs if op != "collect_list"]
+    base = groupby(table, key_names, others) if others else \
+        groupby(table, key_names, [(key_names[0], "count_all")])
+    nkeys = len(key_names)
+
+    key_cols = [table.column(k) for k in key_names]
+    words = [np.asarray(w) for w in
+             encode_keys([SortKey(c) for c in key_cols])]
+    order = np.lexsort(tuple(reversed(words)))
+    sw = [w[order] for w in words]
+    n = len(order)
+    bounds = np.ones(n, np.bool_)
+    if n:
+        bounds[1:] = np.zeros(n - 1, np.bool_)
+        for w in sw:
+            bounds[1:] |= w[1:] != w[:-1]
+    starts = np.flatnonzero(bounds)
+
+    def collect(ref) -> Column:
+        col = table.column(ref)
+        valid = col.validity_numpy()[order]
+        if col.dtype.is_string:
+            vals = col.to_pylist()
+            groups = [[vals[r] for r in order[a:b] if vals[r] is not None]
+                      for a, b in zip(starts, np.append(starts[1:], n))]
+            flat = [v for g in groups for v in g]
+            child = Column.from_pylist(flat, dtype=col.dtype)
+        else:
+            vals = col.to_numpy()[order]
+            groups = [vals[a:b][valid[a:b]]
+                      for a, b in zip(starts, np.append(starts[1:], n))]
+            child = Column.from_numpy(
+                np.concatenate(groups) if groups else
+                np.zeros(0, col.dtype.storage), dtype=col.dtype)
+        lens = np.fromiter((len(g) for g in groups), np.int64, len(starts))
+        offsets = np.zeros(len(starts) + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        if offsets[-1] > np.iinfo(np.int32).max:
+            raise ValueError("collect_list output exceeds int32 offsets")
+        return Column.list_(child, offsets.astype(np.int32))
+
+    out_cols = list(base.columns[:nkeys])
+    oi = nkeys
+    for ref, op in aggs:
+        if op == "collect_list":
+            out_cols.append(collect(ref))
+        else:
+            out_cols.append(base.columns[oi])
+            oi += 1
+    agg_names = names or [
+        f"{op}_{ref if isinstance(ref, str) else i}"
+        for i, (ref, op) in enumerate(aggs)]
+    return Table(out_cols, list(base.names[:nkeys]) + list(agg_names))
+
+
 @traced("groupby")
 def groupby(table: Table, key_names: list, aggs: list[tuple],
             names: list | None = None) -> Table:
     """GROUP BY key_names with aggregations [(column, op), ...] -> compact Table.
 
-    op in {sum, min, max, mean, count, count_all}.
+    op in {sum, min, max, mean, count, count_all, var, std, sumsq, fsum,
+    first, last, collect_list} (the AGGS tuple).  var/std are sample
+    (ddof=1) moments; first/last follow Spark's ignoreNulls=False
+    positional semantics; collect_list drops null elements and returns a
+    LIST column (host-compacted — ragged output can't stay padded).
     """
     # One compiled program instead of eager per-op dispatch: on remote
     # devices each eager op costs a full round trip, which turned this host
     # wrapper into minutes of latency.  Jit requires hashable static specs
     # and fixed-width columns (string keys size their padded matrices on
     # the host).
+    if any(op == "collect_list" for _, op in aggs):
+        return _groupby_with_collect(table, key_names, aggs, names)
     jitable = all(isinstance(k, str) for k in key_names) and \
-        all(isinstance(r, str) for r, _ in aggs)
+        all(isinstance(r, str) for r, _ in aggs) and \
+        all(op in _FAST_OPS for _, op in aggs)
     if jitable:
         try:
             key_cols = [table.column(k) for k in key_names]

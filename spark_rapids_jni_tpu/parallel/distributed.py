@@ -63,6 +63,12 @@ def _expand_aggs(aggs):
             partial_specs.append((ref, "count"))
             final_plan.append((op, si, qi, ci))
         else:
+            if op not in _REAGG:
+                raise ValueError(
+                    f"aggregation {op!r} is not supported in the "
+                    "distributed groupby (no partial/re-aggregation "
+                    f"decomposition); supported: "
+                    f"{sorted(_REAGG) + ['mean', 'var', 'std']}")
             i = len(partial_specs)
             partial_specs.append((ref, op))
             final_plan.append(("direct", i, _REAGG[op]))

@@ -430,3 +430,43 @@ def test_groupby_var_zero_variance_and_big_mean():
     gk = np.array(g2["k"].to_numpy())
     got = np.array(g2["var"].to_pylist(), float)[np.argsort(gk)]
     assert np.allclose(got, o.to_numpy(), rtol=1e-6)
+
+
+def test_groupby_first_last_collect_list():
+    k = np.array([2, 1, 2, 1, 3, 2], np.int64)
+    v = np.array([10, 20, 30, 40, 50, 60], np.int64)
+    vvalid = np.array([1, 1, 0, 1, 1, 1], bool)
+    s = ["a", "b", None, "d", "e", "f"]
+    t = Table([Column.from_numpy(k), Column.from_numpy(v, validity=vvalid),
+               Column.from_pylist(s)], ["k", "v", "s"])
+    g = groupby(t, ["k"], [("v", "collect_list"), ("v", "sum"),
+                           ("v", "first"), ("v", "last"),
+                           ("s", "collect_list")],
+                names=["lst", "sum", "first", "last", "slst"])
+    d = {kk: row for kk, *row in zip(
+        g["k"].to_pylist(), g["lst"].to_pylist(), g["sum"].to_pylist(),
+        g["first"].to_pylist(), g["last"].to_pylist(), g["slst"].to_pylist())}
+    assert d[1] == [[20, 40], 60, 20, 40, ["b", "d"]]
+    assert d[2] == [[10, 60], 70, 10, 60, ["a", "f"]]  # null element dropped
+    assert d[3] == [[50], 50, 50, 50, ["e"]]
+
+
+def test_groupby_first_leading_null_is_null():
+    """Spark first/last default ignoreNulls=False: positional value."""
+    t = Table([Column.from_numpy(np.array([1, 1], np.int64)),
+               Column.from_numpy(np.array([7, 8], np.int64),
+                                 validity=np.array([0, 1], bool))],
+              ["k", "v"])
+    g = groupby(t, ["k"], [("v", "first"), ("v", "last")], names=["f", "l"])
+    assert g["f"].to_pylist() == [None]
+    assert g["l"].to_pylist() == [8]
+
+
+def test_groupby_var_nan_payload_under_null():
+    """NaN stored in a null slot must not poison the group's variance."""
+    v = np.array([np.nan, 3.0, 4.0])
+    t = Table([Column.from_numpy(np.ones(3, np.int64)),
+               Column.from_numpy(v, validity=np.array([0, 1, 1], bool))],
+              ["k", "v"])
+    g = groupby(t, ["k"], [("v", "var")], names=["var"])
+    assert abs(g["var"].to_pylist()[0] - 0.5) < 1e-12
