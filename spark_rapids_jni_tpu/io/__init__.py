@@ -15,6 +15,6 @@ from .parquet import (  # noqa: F401
     read_parquet,
 )
 from .parquet_writer import write_parquet  # noqa: F401
-from .csv import read_csv  # noqa: F401
+from .csv import read_csv, write_csv  # noqa: F401
 from .orc import ORCChunkedReader, ORCFile, read_orc  # noqa: F401
 from .orc_writer import write_orc  # noqa: F401

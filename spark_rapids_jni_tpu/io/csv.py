@@ -101,3 +101,47 @@ def read_csv(path, *, delimiter: str = ",", header: bool = True,
         cols.append(Column.from_numpy(np.asarray(arr, dtype.storage),
                                       validity=valid, dtype=dtype))
     return Table(cols, out_names)
+
+def write_csv(table: Table, path, *, delimiter: str = ",",
+              header: bool = True, na_rep: str = "") -> None:
+    """Write a Table as delimited text (the libcudf CSV-writer role).
+
+    Values render with Spark-compatible text forms: booleans as
+    true/false, decimals with their scale applied, timestamps as raw
+    integer ticks (the engine has no session timezone); nulls as
+    ``na_rep``.  Quoting: fields containing the delimiter, quotes or
+    newlines are double-quoted with embedded quotes doubled (RFC 4180).
+    """
+    import decimal as _decimal
+
+    def render(v):
+        if v is None:
+            return na_rep
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, float):
+            if v != v:
+                return "NaN"  # Spark's text form; repr's 'nan' reads as null
+            if v == float("inf"):
+                return "Infinity"
+            if v == float("-inf"):
+                return "-Infinity"
+            return repr(v)
+        if isinstance(v, _decimal.Decimal):
+            return format(v, "f")
+        s = str(v)
+        return s
+
+    def quote(s: str) -> str:
+        if any(ch in s for ch in (delimiter, '"', "\n", "\r")):
+            return '"' + s.replace('"', '""') + '"'
+        return s
+
+    cols = [c.to_pylist() for c in table.columns]
+    names = [nm or f"c{i}" for i, nm in enumerate(
+        table.names or [f"c{i}" for i in range(table.num_columns)])]
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        if header:
+            f.write(delimiter.join(quote(nm) for nm in names) + "\n")
+        for row in zip(*cols) if cols else ():
+            f.write(delimiter.join(quote(render(v)) for v in row) + "\n")

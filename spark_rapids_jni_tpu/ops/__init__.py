@@ -22,3 +22,11 @@ from .cast_strings import (  # noqa: F401
 )
 from .regex_rewrite import regex_matches  # noqa: F401
 from .dictionary import dictionary_encode, dictionary_decode  # noqa: F401
+from .selection import (  # noqa: F401
+    apply_boolean_mask, concat_tables, distinct, gather_table, sort_table,
+    slice_table,
+)
+from .aggregate import groupby  # noqa: F401
+from .join import (  # noqa: F401
+    inner_join, left_join, left_semi_join, left_anti_join,
+)

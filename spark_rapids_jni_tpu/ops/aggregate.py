@@ -618,7 +618,7 @@ def _groupby_with_collect(table: Table, key_names: list, aggs: list,
     starts = np.flatnonzero(bounds)
 
     def collect(ref) -> Column:
-        col = table.column(ref)
+        col = ref if isinstance(ref, Column) else table.column(ref)
         valid = col.validity_numpy()[order]
         if col.dtype.is_string:
             vals = col.to_pylist()

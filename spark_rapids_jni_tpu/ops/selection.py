@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column, Table
+from ..dtypes import TypeId
 from .order import SortKey, sort_indices
 from .strings_common import to_padded_bytes, from_padded_bytes
 from ..utils.tracing import traced
@@ -94,6 +95,78 @@ def sort_table(table: Table, keys: list[SortKey]) -> Table:
     """cudf sorted_order + gather as one call."""
     order = sort_indices(keys)
     return gather_table(table, order)
+
+
+def concat_tables(tables: list[Table]) -> Table:
+    """Vertical concatenation of same-schema Tables (cudf concatenate).
+
+    Host-boundary op: output length is the sum of inputs, so this runs
+    outside jit (like compaction).  STRING/LIST offsets are rebased; the
+    result lands back on the device.
+    """
+    if not tables:
+        raise ValueError("concat_tables needs at least one table")
+    if len(tables) == 1:
+        return tables[0]
+    first = tables[0]
+    for t in tables[1:]:
+        if tuple(t.dtypes()) != tuple(first.dtypes()):
+            raise TypeError("concat_tables requires identical schemas")
+    cols = [_concat_columns([t.columns[i] for t in tables])
+            for i in range(first.num_columns)]
+    return Table(cols, first.names)
+
+
+def _concat_columns(parts: list[Column]) -> Column:
+    d0 = parts[0].dtype
+    any_valid = any(p.validity is not None for p in parts)
+    valid = np.concatenate([p.validity_numpy() for p in parts]) \
+        if any_valid else None
+    if d0.id == TypeId.STRUCT:
+        kids = tuple(_concat_columns([p.children[i] for p in parts])
+                     for i in range(len(parts[0].children)))
+        return Column(d0, validity=None if valid is None
+                      else jnp.asarray(valid), children=kids)
+    if d0.is_string or d0.id == TypeId.LIST:
+        offs = [np.asarray(parts[0].offsets, np.int64)]
+        base = int(offs[0][-1])
+        for p in parts[1:]:
+            o = np.asarray(p.offsets, np.int64)
+            offs.append(o[1:] + base)
+            base += int(o[-1])
+        offsets = np.concatenate(offs)
+        if offsets[-1] > np.iinfo(np.int32).max:
+            raise ValueError("concatenated column exceeds int32 offsets")
+        if d0.is_string:
+            chars = np.concatenate([np.asarray(p.data) for p in parts])
+            return Column.string(chars, offsets.astype(np.int32), valid)
+        child = _concat_columns([p.children[0] for p in parts])
+        return Column.list_(child, offsets.astype(np.int32), valid)
+    data = np.concatenate([np.asarray(p.data) for p in parts])
+    return Column(d0, data=jnp.asarray(data),
+                  validity=None if valid is None else jnp.asarray(valid))
+
+
+def distinct(table: Table, subset: list | None = None) -> Table:
+    """Spark dropDuplicates: keep the first row of each key group.
+
+    Returns FULL rows (all columns), deduplicated over ``subset`` (default:
+    all columns).  Null keys compare equal (one null group).  Host-boundary
+    op: the surviving-row count is data-dependent."""
+    from .order import encode_keys
+    keys = list(subset) if subset is not None else list(table.names)
+    words = [np.asarray(w) for w in
+             encode_keys([SortKey(table.column(k)) for k in keys])]
+    order = np.lexsort(tuple(reversed(words)))
+    sw = [w[order] for w in words]
+    n = len(order)
+    firsts = np.ones(n, np.bool_)
+    if n:
+        firsts[1:] = np.zeros(n - 1, np.bool_)
+        for w in sw:
+            firsts[1:] |= w[1:] != w[:-1]
+    keep = np.sort(order[np.flatnonzero(firsts)])  # first row, input order
+    return gather_table(table, jnp.asarray(keep.astype(np.int32)))
 
 
 def slice_table(table: Table, start: int, length: int) -> Table:

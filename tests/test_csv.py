@@ -5,6 +5,7 @@ import pytest
 
 from spark_rapids_jni_tpu import dtypes as dt
 from spark_rapids_jni_tpu.io import read_csv
+from spark_rapids_jni_tpu.columnar import Column, Table
 
 
 def test_inference_and_nulls(tmp_path):
@@ -83,3 +84,40 @@ def test_nullable_int64_inference_exact(tmp_path):
     t = read_csv(p)
     assert t["v"].dtype == dt.INT64
     assert t["v"].to_pylist() == [big, None, big + 2]
+
+
+class TestWriteCsv:
+    def test_roundtrip_with_quoting_and_nulls(self, tmp_path):
+        import pandas as pd
+        from spark_rapids_jni_tpu.io import write_csv
+        t = Table([
+            Column.from_numpy(np.array([1, 2, 3], np.int64)),
+            Column.from_pylist(["plain", None, 'has,"quote"\nline']),
+            Column.from_numpy(np.array([1.5, -2.25, 0.0])),
+            Column.from_numpy(np.array([True, False, True])),
+        ], ["x", "s", "f", "b"])
+        p = tmp_path / "o.csv"
+        write_csv(t, p)
+        pdf = pd.read_csv(p)
+        assert pdf["x"].tolist() == [1, 2, 3]
+        assert pdf["s"].tolist()[2] == 'has,"quote"\nline'
+        assert pd.isna(pdf["s"].tolist()[1])
+        assert pdf["f"].tolist() == [1.5, -2.25, 0.0]
+        assert pdf["b"].tolist() == [True, False, True]
+        back = read_csv(p)
+        assert back["x"].to_pylist() == [1, 2, 3]
+        assert back["s"].to_pylist()[2] == 'has,"quote"\nline'
+
+
+def test_concat_tables_and_distinct():
+    from spark_rapids_jni_tpu.ops import concat_tables, distinct
+    t1 = Table([Column.from_numpy(np.array([1, 2], np.int64)),
+                Column.from_pylist(["a", None])], ["x", "s"])
+    t2 = Table([Column.from_numpy(np.array([2], np.int64)),
+                Column.from_pylist(["b"])], ["x", "s"])
+    c = concat_tables([t1, t2])
+    assert c.num_rows == 3
+    assert c["s"].to_pylist() == ["a", None, "b"]
+    d = distinct(c, subset=["x"])
+    assert d["x"].to_pylist() == [1, 2]      # first row per key, input order
+    assert d["s"].to_pylist() == ["a", None]  # full rows survive
