@@ -121,7 +121,10 @@ def write_csv(table: Table, path, *, delimiter: str = ",",
             return "true" if v else "false"
         if isinstance(v, float):
             if v != v:
-                return "NaN"  # Spark's text form; repr's 'nan' reads as null
+                # Spark's text form.  CSV cannot distinguish NaN from null
+                # without reader options (Spark: nanValue); this package's
+                # read_csv also maps it to null — a lossy round trip.
+                return "NaN"
             if v == float("inf"):
                 return "Infinity"
             if v == float("-inf"):

@@ -30,3 +30,9 @@ from .aggregate import groupby  # noqa: F401
 from .join import (  # noqa: F401
     inner_join, left_join, left_semi_join, left_anti_join,
 )
+from .binary import (  # noqa: F401
+    add, subtract, multiply, true_divide, floor_div, modulo,
+    eq, ne, lt, le, gt, ge, eq_null_safe,
+    logical_and, logical_or, logical_not, negate, abs_,
+    is_null, is_not_null, coalesce,
+)

@@ -1,0 +1,232 @@
+"""Column expression ops: null-propagating arithmetic/comparison/logical.
+
+The libcudf binary/unary-op role (SURVEY.md §2.2 "algorithms"): Spark
+projects expressions over columns before/after the relational ops.  Rules
+follow Spark SQL:
+
+- null in → null out (except null-safe equality and AND/OR short-circuit
+  truth tables);
+- integer division/modulo by zero → null (Spark returns null, not error);
+- FLOAT64 columns store bit patterns (dtypes.device_storage), so float
+  arithmetic round-trips through utils.floatbits;
+- comparisons return BOOL8 columns.
+
+Everything is elementwise and jit-safe (fixed shapes, no host syncs).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import Column
+from ..dtypes import BOOL8, DType, FLOAT64, INT64, TypeId
+from ..utils.tracing import traced
+
+
+def _vals(col: Column) -> jnp.ndarray:
+    """Computation view of a column's data (floats as hardware floats)."""
+    if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return col.float_values()
+    if col.dtype.id == TypeId.BOOL8:
+        return col.data.astype(jnp.bool_)
+    return col.data
+
+
+def _both_valid(a: Column, b: Column):
+    if a.validity is None and b.validity is None:
+        return None
+    return a.valid_mask() & b.valid_mask()
+
+
+def _result(dtype: DType, data, valid) -> Column:
+    if dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return Column.fixed(dtype, data, validity=valid)
+    if dtype.id == TypeId.BOOL8:
+        return Column(BOOL8, data=data.astype(jnp.uint8), validity=valid)
+    return Column(dtype, data=data.astype(jnp.dtype(dtype.device_storage)),
+                  validity=valid)
+
+
+def _numeric_out_dtype(a: DType, b: DType) -> DType:
+    if TypeId.FLOAT64 in (a.id, b.id) or TypeId.FLOAT32 in (a.id, b.id):
+        return FLOAT64
+    return INT64
+
+
+def _arith(a: Column, b: Column, fn, out_dtype=None) -> Column:
+    av, bv = _vals(a), _vals(b)
+    out = out_dtype or _numeric_out_dtype(a.dtype, b.dtype)
+    if out.id == TypeId.FLOAT64:
+        av = av.astype(jnp.float64)
+        bv = bv.astype(jnp.float64)
+    return _result(out, fn(av, bv), _both_valid(a, b))
+
+
+@traced("binary_op")
+def add(a: Column, b: Column) -> Column:
+    return _arith(a, b, jnp.add)
+
+
+@traced("binary_op")
+def subtract(a: Column, b: Column) -> Column:
+    return _arith(a, b, jnp.subtract)
+
+
+@traced("binary_op")
+def multiply(a: Column, b: Column) -> Column:
+    return _arith(a, b, jnp.multiply)
+
+
+@traced("binary_op")
+def true_divide(a: Column, b: Column) -> Column:
+    """Spark ``/``: always double; x/0 is null (not inf) for nonzero x."""
+    av = _vals(a).astype(jnp.float64)
+    bv = _vals(b).astype(jnp.float64)
+    zero = bv == 0.0
+    safe = jnp.where(zero, 1.0, bv)
+    valid = _both_valid(a, b)
+    valid = ~zero if valid is None else (valid & ~zero)
+    return _result(FLOAT64, av / safe, valid)
+
+
+@traced("binary_op")
+def floor_div(a: Column, b: Column) -> Column:
+    """Spark ``div``: integral quotient; by-zero is null."""
+    av = _vals(a).astype(jnp.int64)
+    bv = _vals(b).astype(jnp.int64)
+    zero = bv == 0
+    safe = jnp.where(zero, jnp.int64(1), bv)
+    # Spark div truncates toward zero (Java semantics), unlike // (floor)
+    q = (jnp.abs(av) // jnp.abs(safe)) * jnp.sign(av) * jnp.sign(safe)
+    valid = _both_valid(a, b)
+    valid = ~zero if valid is None else (valid & ~zero)
+    return _result(INT64, q, valid)
+
+
+@traced("binary_op")
+def modulo(a: Column, b: Column) -> Column:
+    """Spark ``%``: sign follows the dividend (Java), by-zero is null."""
+    av = _vals(a).astype(jnp.int64)
+    bv = _vals(b).astype(jnp.int64)
+    zero = bv == 0
+    safe = jnp.where(zero, jnp.int64(1), bv)
+    r = jnp.sign(av) * (jnp.abs(av) % jnp.abs(safe))
+    valid = _both_valid(a, b)
+    valid = ~zero if valid is None else (valid & ~zero)
+    return _result(INT64, r, valid)
+
+
+def _compare(a: Column, b: Column, fn) -> Column:
+    av, bv = _vals(a), _vals(b)
+    if a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64) or \
+            b.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        av = av.astype(jnp.float64)
+        bv = bv.astype(jnp.float64)
+    return _result(BOOL8, fn(av, bv), _both_valid(a, b))
+
+
+@traced("binary_op")
+def eq(a: Column, b: Column) -> Column:
+    return _compare(a, b, jnp.equal)
+
+
+@traced("binary_op")
+def ne(a: Column, b: Column) -> Column:
+    return _compare(a, b, jnp.not_equal)
+
+
+@traced("binary_op")
+def lt(a: Column, b: Column) -> Column:
+    return _compare(a, b, jnp.less)
+
+
+@traced("binary_op")
+def le(a: Column, b: Column) -> Column:
+    return _compare(a, b, jnp.less_equal)
+
+
+@traced("binary_op")
+def gt(a: Column, b: Column) -> Column:
+    return _compare(a, b, jnp.greater)
+
+
+@traced("binary_op")
+def ge(a: Column, b: Column) -> Column:
+    return _compare(a, b, jnp.greater_equal)
+
+
+@traced("binary_op")
+def eq_null_safe(a: Column, b: Column) -> Column:
+    """Spark ``<=>``: nulls compare equal; never returns null."""
+    av, bv = _vals(a), _vals(b)
+    va, vb = a.valid_mask(), b.valid_mask()
+    same = jnp.equal(av, bv) & va & vb
+    both_null = ~va & ~vb
+    return Column(BOOL8, data=(same | both_null).astype(jnp.uint8))
+
+
+@traced("binary_op")
+def logical_and(a: Column, b: Column) -> Column:
+    """SQL three-valued AND: false dominates null."""
+    av = _vals(a).astype(jnp.bool_)
+    bv = _vals(b).astype(jnp.bool_)
+    va, vb = a.valid_mask(), b.valid_mask()
+    false_a = va & ~av
+    false_b = vb & ~bv
+    out = av & bv
+    valid = (va & vb) | false_a | false_b
+    return Column(BOOL8, data=out.astype(jnp.uint8), validity=valid)
+
+
+@traced("binary_op")
+def logical_or(a: Column, b: Column) -> Column:
+    """SQL three-valued OR: true dominates null."""
+    av = _vals(a).astype(jnp.bool_)
+    bv = _vals(b).astype(jnp.bool_)
+    va, vb = a.valid_mask(), b.valid_mask()
+    true_a = va & av
+    true_b = vb & bv
+    out = av | bv
+    valid = (va & vb) | true_a | true_b
+    return Column(BOOL8, data=out.astype(jnp.uint8), validity=valid)
+
+
+@traced("unary_op")
+def logical_not(a: Column) -> Column:
+    av = _vals(a).astype(jnp.bool_)
+    return Column(BOOL8, data=(~av).astype(jnp.uint8), validity=a.validity)
+
+
+@traced("unary_op")
+def negate(a: Column) -> Column:
+    return _result(a.dtype, -_vals(a), a.validity)
+
+
+@traced("unary_op")
+def abs_(a: Column) -> Column:
+    return _result(a.dtype, jnp.abs(_vals(a)), a.validity)
+
+
+@traced("unary_op")
+def is_null(a: Column) -> Column:
+    return Column(BOOL8, data=(~a.valid_mask()).astype(jnp.uint8))
+
+
+@traced("unary_op")
+def is_not_null(a: Column) -> Column:
+    return Column(BOOL8, data=a.valid_mask().astype(jnp.uint8))
+
+
+@traced("unary_op")
+def coalesce(*cols: Column) -> Column:
+    """First non-null value per row across the arguments (same dtype)."""
+    if not cols:
+        raise ValueError("coalesce needs at least one column")
+    out_v = _vals(cols[0])
+    out_ok = cols[0].valid_mask()
+    for c in cols[1:]:
+        cv = _vals(c)
+        take = ~out_ok & c.valid_mask()
+        out_v = jnp.where(take, cv.astype(out_v.dtype), out_v)
+        out_ok = out_ok | c.valid_mask()
+    return _result(cols[0].dtype, out_v, out_ok)

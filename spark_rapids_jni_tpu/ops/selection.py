@@ -110,11 +110,21 @@ def concat_tables(tables: list[Table]) -> Table:
         return tables[0]
     first = tables[0]
     for t in tables[1:]:
-        if tuple(t.dtypes()) != tuple(first.dtypes()):
-            raise TypeError("concat_tables requires identical schemas")
+        if t.num_columns != first.num_columns or any(
+                not _schema_matches(a, b)
+                for a, b in zip(first.columns, t.columns)):
+            raise TypeError("concat_tables requires identical schemas "
+                            "(including nested child types)")
     cols = [_concat_columns([t.columns[i] for t in tables])
             for i in range(first.num_columns)]
     return Table(cols, first.names)
+
+
+def _schema_matches(a: Column, b: Column) -> bool:
+    if a.dtype != b.dtype or len(a.children) != len(b.children):
+        return False
+    return all(_schema_matches(ca, cb)
+               for ca, cb in zip(a.children, b.children))
 
 
 def _concat_columns(parts: list[Column]) -> Column:
@@ -153,19 +163,14 @@ def distinct(table: Table, subset: list | None = None) -> Table:
     Returns FULL rows (all columns), deduplicated over ``subset`` (default:
     all columns).  Null keys compare equal (one null group).  Host-boundary
     op: the surviving-row count is data-dependent."""
-    from .order import encode_keys
-    keys = list(subset) if subset is not None else list(table.names)
-    words = [np.asarray(w) for w in
-             encode_keys([SortKey(table.column(k)) for k in keys])]
-    order = np.lexsort(tuple(reversed(words)))
-    sw = [w[order] for w in words]
-    n = len(order)
-    firsts = np.ones(n, np.bool_)
-    if n:
-        firsts[1:] = np.zeros(n - 1, np.bool_)
-        for w in sw:
-            firsts[1:] |= w[1:] != w[:-1]
-    keep = np.sort(order[np.flatnonzero(firsts)])  # first row, input order
+    from .order import encode_keys, rows_differ_from_prev
+    key_cols = list(table.columns) if subset is None else \
+        [table.column(k) for k in subset]
+    sk = [SortKey(c) for c in key_cols]
+    order = sort_indices(sk)
+    bounds = rows_differ_from_prev(encode_keys(sk), order)
+    # stable sort → the boundary row of each group is its earliest input row
+    keep = np.sort(np.asarray(order)[np.asarray(bounds)])
     return gather_table(table, jnp.asarray(keep.astype(np.int32)))
 
 

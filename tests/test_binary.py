@@ -1,0 +1,107 @@
+"""Column expression ops vs Spark SQL semantics (null propagation,
+three-valued logic, by-zero-null division, truncating div/mod)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import (abs_, add, coalesce, eq, eq_null_safe,
+                                      floor_div, is_null, logical_and,
+                                      logical_not, logical_or, modulo,
+                                      multiply, negate, subtract,
+                                      true_divide, lt)
+
+
+def col(vals, dtype=None, valid=None):
+    arr = np.asarray(vals)
+    return Column.from_numpy(arr, validity=None if valid is None
+                             else np.asarray(valid, bool),
+                             dtype=dtype)
+
+
+def test_arith_null_propagation():
+    a = col([1, 2, 3], valid=[1, 0, 1])
+    b = col([10, 20, 30], valid=[1, 1, 0])
+    assert add(a, b).to_pylist() == [11, None, None]
+    assert subtract(b, a).to_pylist() == [9, None, None]
+    assert multiply(a, b).to_pylist() == [10, None, None]
+
+
+def test_float_arith_and_dtype_widening():
+    a = col([1.5, 2.5, -1.0])
+    b = col([2, 4, 8])
+    out = multiply(a, b)
+    assert out.dtype == dt.FLOAT64
+    assert out.to_pylist() == [3.0, 10.0, -8.0]
+
+
+def test_divide_by_zero_is_null():
+    a = col([10, 7, -9])
+    b = col([2, 0, 3])
+    assert true_divide(a, b).to_pylist() == [5.0, None, -3.0]
+    assert floor_div(a, b).to_pylist() == [5, None, -3]
+    assert modulo(a, b).to_pylist() == [0, None, 0]
+
+
+def test_div_mod_truncate_toward_zero():
+    a = col([-7, 7, -7, 7])
+    b = col([2, 2, -2, -2])
+    assert floor_div(a, b).to_pylist() == [-3, 3, 3, -3]  # Java semantics
+    assert modulo(a, b).to_pylist() == [-1, 1, -1, 1]     # sign of dividend
+
+
+def test_comparisons_and_null_safe_eq():
+    a = col([1, 2, 3], valid=[1, 0, 1])
+    b = col([1, 2, 4], valid=[1, 0, 1])
+    assert eq(a, b).to_pylist() == [True, None, False]
+    assert lt(a, b).to_pylist() == [False, None, True]
+    assert eq_null_safe(a, b).to_pylist() == [True, True, False]
+
+
+def test_three_valued_logic():
+    t = col([1, 1, 1], dtype=dt.BOOL8)
+    f = col([0, 0, 0], dtype=dt.BOOL8)
+    n = col([1, 0, 1], dtype=dt.BOOL8, valid=[0, 0, 0])
+    assert logical_and(f, n).to_pylist() == [False] * 3   # false & null
+    assert logical_and(t, n).to_pylist() == [None] * 3    # true & null
+    assert logical_or(t, n).to_pylist() == [True] * 3     # true | null
+    assert logical_or(f, n).to_pylist() == [None] * 3     # false | null
+    assert logical_not(n).to_pylist() == [None] * 3
+
+
+def test_unary_and_coalesce():
+    a = col([1, -2, 3], valid=[1, 1, 0])
+    assert negate(a).to_pylist() == [-1, 2, None]
+    assert abs_(col([-1.5, 2.5, -0.0])).to_pylist() == [1.5, 2.5, 0.0]
+    assert is_null(a).to_pylist() == [False, False, True]
+    b = col([10, 20, 30])
+    assert coalesce(a, b).to_pylist() == [1, -2, 30]
+
+
+def test_jit_traces_end_to_end():
+    import jax
+
+    @jax.jit
+    def expr(a: Column, b: Column):
+        return add(multiply(a, b), negate(b))
+
+    a = col([1, 2, 3], valid=[1, 1, 0])
+    b = col([10, 20, 30])
+    assert expr(a, b).to_pylist() == [0, 20, None]
+
+
+def test_concat_rejects_mismatched_nested_schemas():
+    from spark_rapids_jni_tpu.ops import concat_tables
+    li = Column.list_(Column.from_numpy(np.array([1, 2], np.int64)),
+                      np.array([0, 2], np.int32))
+    ls = Column.list_(Column.from_pylist(["a"]), np.array([0, 1], np.int32))
+    with pytest.raises(TypeError):
+        concat_tables([Table([li], ["l"]), Table([ls], ["l"])])
+
+
+def test_distinct_unnamed_table():
+    from spark_rapids_jni_tpu.ops import distinct
+    t = Table([Column.from_numpy(np.array([3, 3, 1], np.int64))])
+    d = distinct(t)
+    assert d.columns[0].to_pylist() == [3, 1]
