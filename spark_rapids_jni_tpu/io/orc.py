@@ -421,7 +421,17 @@ class ORCFile:
                 tail = f.read(need)
         footer_raw = tail[len(tail) - 1 - ps_len - footer_len:
                           len(tail) - 1 - ps_len]
+        meta_raw = tail[len(tail) - 1 - ps_len - footer_len - meta_len:
+                        len(tail) - 1 - ps_len - footer_len]
         footer = _pb_fields(_decode_stream(footer_raw, self.compression))
+        # Metadata section: per-stripe, per-column statistics (min/max) —
+        # the stripe-pruning analog of parquet row-group footer stats
+        self._stripe_stats: list = []
+        if meta_len:
+            meta = _pb_fields(_decode_stream(meta_raw, self.compression))
+            for ss in meta.get(1, ()):  # repeated StripeStatistics
+                cols = [_pb_fields(cs) for cs in _pb_fields(ss).get(1, ())]
+                self._stripe_stats.append(cols)
         self.num_rows = _pb_u(footer, 6)
         self.types = [
             _OrcType(kind=_pb_u(tf, 1), subtypes=_pb_packed(tf, 2),
@@ -446,6 +456,45 @@ class ORCFile:
     @property
     def num_stripes(self) -> int:
         return len(self.stripes)
+
+    def stripe_stat_range(self, stripe: int, column: str):
+        """(min, max) for a column over one stripe, or None if absent.
+
+        Int/date stats are zigzag varints; double stats are fixed64 IEEE;
+        string stats are raw bytes (returned as str)."""
+        if stripe >= len(self._stripe_stats):
+            return None
+        try:
+            cid = self.column_ids[self.column_names.index(column)]
+        except ValueError:
+            return None
+        cols = self._stripe_stats[stripe]
+        if cid >= len(cols):
+            return None
+        cs = cols[cid]
+
+        def zz(v):
+            return (v >> 1) ^ -(v & 1)
+
+        if 2 in cs:  # IntStatistics {1 min, 2 max} (sint64)
+            f = _pb_fields(cs[2][0])
+            if 1 in f and 2 in f:
+                return zz(f[1][0]), zz(f[2][0])
+        if 3 in cs:  # DoubleStatistics {1 min, 2 max} (fixed64 doubles)
+            import struct as _struct
+            f = _pb_fields(cs[3][0])
+            if 1 in f and 2 in f:
+                return (_struct.unpack("<d", int(f[1][0]).to_bytes(8, "little"))[0],
+                        _struct.unpack("<d", int(f[2][0]).to_bytes(8, "little"))[0])
+        if 4 in cs:  # StringStatistics {1 min, 2 max} (bytes)
+            f = _pb_fields(cs[4][0])
+            if 1 in f and 2 in f:
+                return bytes(f[1][0]).decode(), bytes(f[2][0]).decode()
+        if 7 in cs:  # DateStatistics {1 min, 2 max} (sint32 days)
+            f = _pb_fields(cs[7][0])
+            if 1 in f and 2 in f:
+                return zz(f[1][0]), zz(f[2][0])
+        return None
 
     # -- stripe decode -----------------------------------------------------
     def _stripe_streams(self, st: _Stripe):
@@ -682,12 +731,46 @@ class ORCChunkedReader:
     Stripes are ORC's native bounded unit (the writer sizes them to
     `stripe_size`), so the per-pass device working set is bounded by file
     layout exactly like ParquetChunkedReader bounds it by byte budget.
+    ``predicate=(column, lo, hi)`` prunes whole stripes via the metadata
+    section's stripe statistics before any stream decode (the parquet
+    footer-stats analog); either bound may be None.
     """
 
-    def __init__(self, path, columns=None):
+    def __init__(self, path, columns=None, predicate: tuple | None = None):
         self.file = ORCFile(path)
         self.columns = columns
+        self.predicate = predicate
+        if predicate is not None:
+            col, lo, hi = predicate
+            if col not in self.file.column_names:
+                raise KeyError(f"predicate column {col!r} not in "
+                               f"{list(self.file.column_names)}")
+            # bound types must be comparable with the column's stat kind
+            rng = next((self.file.stripe_stat_range(i, col)
+                        for i in range(self.file.num_stripes)), None)
+            if rng is not None:
+                for b in (lo, hi):
+                    if b is not None:
+                        try:
+                            b < rng[0]  # noqa: B015 — comparability probe
+                        except TypeError:
+                            raise TypeError(
+                                f"predicate bound {b!r} is not comparable "
+                                f"with {col!r} statistics ({type(rng[0]).__name__})")
+
+    def _pruned(self, i: int) -> bool:
+        if self.predicate is None:
+            return False
+        col, lo, hi = self.predicate
+        rng = self.file.stripe_stat_range(i, col)
+        if rng is None:
+            return False
+        smin, smax = rng
+        return (hi is not None and smin > hi) or \
+               (lo is not None and smax < lo)
 
     def __iter__(self):
         for i in range(self.file.num_stripes):
+            if self._pruned(i):
+                continue
             yield self.file.read_stripe(i, self.columns)

@@ -277,3 +277,52 @@ class TestStripes:
         assert list(only_b.names) == ["b"]
         assert only_b["b"].to_pylist() == ["x", "y", "z"]
         assert_matches(got, t)
+
+
+class TestStripeStats:
+    def test_predicate_prunes_stripes(self, tmp_path):
+        n = 3_000_000
+        t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64)),
+                      "f": pa.array(np.linspace(-5.0, 5.0, n))})
+        p = tmp_path / "s.orc"
+        orc.write_table(t, p, compression="snappy",
+                        stripe_size=4 * 1024 * 1024)
+        f = ORCFile(p)
+        assert f.num_stripes > 2
+        rng0 = f.stripe_stat_range(0, "x")
+        assert rng0 is not None and rng0[0] == 0
+        fr = f.stripe_stat_range(0, "f")
+        assert fr is not None and fr[0] == pytest.approx(-5.0)
+
+        lo = n - 10
+        rows = 0
+        stripes = 0
+        for chunk in ORCChunkedReader(p, columns=["x"],
+                                      predicate=("x", lo, None)):
+            stripes += 1
+            rows += chunk.num_rows
+            vals = np.asarray(chunk["x"].data)
+            assert vals.max() >= lo
+        assert stripes == 1  # every other stripe pruned by stats
+        assert rows >= 10
+
+    def test_no_stats_means_no_pruning(self, tmp_path):
+        from spark_rapids_jni_tpu.columnar import Column, Table
+        from spark_rapids_jni_tpu.io import write_orc
+        t = Table([Column.from_numpy(np.arange(100, dtype=np.int64))], ["x"])
+        p = tmp_path / "w.orc"
+        write_orc(t, p)  # our writer emits no metadata section
+        chunks = list(ORCChunkedReader(p, predicate=("x", 1000, None)))
+        assert sum(c.num_rows for c in chunks) == 100  # kept, not dropped
+
+    def test_predicate_validation(self, tmp_path):
+        t = pa.table({"x": pa.array([1, 2, 3], pa.int64()),
+                      "s": pa.array(["a", "b", "c"])})
+        p = tmp_path / "v.orc"
+        orc.write_table(t, p)
+        with pytest.raises(KeyError):
+            ORCChunkedReader(p, predicate=("nope", 0, 1))
+        with pytest.raises(TypeError):
+            ORCChunkedReader(p, predicate=("s", 0, 10))
+        # string bounds on a string column are fine
+        list(ORCChunkedReader(p, predicate=("s", "a", "z")))
