@@ -746,8 +746,9 @@ class ORCChunkedReader:
                 raise KeyError(f"predicate column {col!r} not in "
                                f"{list(self.file.column_names)}")
             # bound types must be comparable with the column's stat kind
-            rng = next((self.file.stripe_stat_range(i, col)
-                        for i in range(self.file.num_stripes)), None)
+            rng = next((r for r in (self.file.stripe_stat_range(i, col)
+                                    for i in range(self.file.num_stripes))
+                        if r is not None), None)
             if rng is not None:
                 for b in (lo, hi):
                     if b is not None:

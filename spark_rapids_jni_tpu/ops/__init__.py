@@ -36,3 +36,4 @@ from .binary import (  # noqa: F401
     logical_and, logical_or, logical_not, negate, abs_,
     is_null, is_not_null, coalesce,
 )
+from .window import window  # noqa: F401

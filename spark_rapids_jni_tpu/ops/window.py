@@ -1,0 +1,228 @@
+"""Window functions: partitioned, ordered analytics over rows.
+
+The libcudf rolling/window role (SURVEY.md §2.2 "algorithms"; Spark plans
+these as WindowExec over GpuWindow).  Same TPU shape as the groupby
+(docs/PERF.md "sorts over scatters"): ONE multi-operand sort by
+(partition, order) keys carries every input column; ranks and running
+aggregates are cumulative/segmented scans; results ride a second
+payload-carrying sort back to input row order — no gathers, no scatters.
+
+Supported window ops (Spark names):
+- ``row_number``                        1-based position in the partition
+- ``rank`` / ``dense_rank``             ties share a rank
+- ``lag`` / ``lead`` (offset k)         null outside the partition
+- ``sum`` / ``min`` / ``max`` / ``count`` / ``mean``
+  running aggregates over UNBOUNDED PRECEDING .. CURRENT ROW
+
+All jit-safe: fixed shapes, no host syncs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Column, Table
+from ..dtypes import FLOAT64, INT64, TypeId
+from .aggregate import _float64_vals, _seg_scan, _shift_down
+from .order import SortKey, encode_keys
+from ..utils.tracing import traced
+
+
+def _shift_up(arr, shift: int, fill):
+    """arr shifted so row i sees row i+shift (back-filled)."""
+    pad = jnp.full((shift,) + arr.shape[1:], fill, arr.dtype)
+    return jnp.concatenate([arr[shift:], pad], axis=0)
+
+
+def _running(op: str, col: Column, sval, svalid, seg):
+    """Running aggregate over the ordered partition prefix (inclusive)."""
+    n = sval.shape[0] if sval is not None else seg.shape[0]
+    if op == "count":
+        m = svalid.astype(jnp.int64)
+        return Column(INT64, data=_seg_scan(m, seg, jnp.add,
+                                            jnp.zeros((), jnp.int64)))
+    if op in ("sum", "mean"):
+        vf = _float64_vals(col, sval) if col.dtype.id in (
+            TypeId.FLOAT32, TypeId.FLOAT64) else sval.astype(jnp.int64)
+        zero = jnp.zeros((), vf.dtype)
+        m = jnp.where(svalid, vf, zero)
+        s = _seg_scan(m, seg, jnp.add, zero)
+        cnt = _seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
+                        jnp.zeros((), jnp.int64))
+        if op == "mean":
+            mean = s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(
+                jnp.float64)
+            return Column.fixed(FLOAT64, mean, validity=cnt > 0)
+        if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return Column.fixed(FLOAT64, s, validity=cnt > 0)
+        return Column(INT64, data=s, validity=cnt > 0)
+    if op in ("min", "max"):
+        if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            from . import order as _order
+            enc = _order._fixed_to_u64(Column(col.dtype, data=sval))
+            ident = jnp.uint64(2**64 - 1) if op == "min" else jnp.uint64(0)
+            enc = jnp.where(svalid, enc, ident)
+            combine = jnp.minimum if op == "min" else jnp.maximum
+            red = _seg_scan(enc, seg, combine, ident)
+            cnt = _seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
+                            jnp.zeros((), jnp.int64))
+            data = _order.decode_minmax_bits(red, col.dtype)
+            return Column(col.dtype, data=data, validity=cnt > 0)
+        if jnp.issubdtype(sval.dtype, jnp.integer):
+            info = jnp.iinfo(sval.dtype)
+            ident = jnp.asarray(info.max if op == "min" else info.min,
+                                sval.dtype)
+        else:
+            ident = jnp.asarray(jnp.inf if op == "min" else -jnp.inf,
+                                sval.dtype)
+        m = jnp.where(svalid, sval, ident)
+        combine = jnp.minimum if op == "min" else jnp.maximum
+        red = _seg_scan(m, seg, combine, ident)
+        cnt = _seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
+                        jnp.zeros((), jnp.int64))
+        return Column(col.dtype, data=red, validity=cnt > 0)
+    raise ValueError(f"unknown window aggregate {op!r}")
+
+
+@traced("window")
+def window(table: Table, partition_by: list, order_by: list,
+           specs: list[tuple], names: list | None = None) -> Table:
+    """Append window columns; rows keep their input order.
+
+    ``specs``: list of (column_or_None, op) or (column, op, k) for lag/lead.
+    ``order_by`` entries may be column names or SortKey for descending.
+    """
+    n = table.num_rows
+    pkeys = [SortKey(table.column(k)) if not isinstance(k, SortKey) else k
+             for k in partition_by]
+    okeys = [SortKey(table.column(k)) if not isinstance(k, SortKey) else k
+             for k in order_by]
+    pwords = encode_keys(pkeys)
+    owords = encode_keys(okeys)
+    nw_p, nw_o = len(pwords), len(owords)
+
+    # distinct value columns ride the sort once each
+    distinct_cols: list[Column] = []
+    slot_of: dict[int, int] = {}
+    resolved = []
+    for spec in specs:
+        ref, op, *rest = spec
+        col = None
+        if ref is None:
+            if op == "count":  # count(*) over the window == row_number
+                op = "row_number"
+            elif op not in ("row_number", "rank", "dense_rank"):
+                raise ValueError(
+                    f"window op {op!r} needs a value column (got None)")
+        else:
+            col = ref if isinstance(ref, Column) else table.column(ref)
+            if col.dtype.is_string:
+                raise TypeError("string value columns are not supported in "
+                                "window aggregates")
+            if id(col) not in slot_of:
+                slot_of[id(col)] = len(distinct_cols)
+                distinct_cols.append(col)
+        k = int(rest[0]) if rest else 1
+        if op in ("lag", "lead") and k < 0:  # Spark: lag(-k) == lead(k)
+            op = "lead" if op == "lag" else "lag"
+            k = -k
+        resolved.append((col, op, k))
+
+    payloads = [jnp.arange(n, dtype=jnp.int32)]  # original row index
+    for c in distinct_cols:
+        payloads.append(c.data)
+        payloads.append(c.valid_mask().astype(jnp.uint8))
+    sorted_all = jax.lax.sort(tuple(pwords) + tuple(owords) + tuple(payloads),
+                              num_keys=nw_p + nw_o, is_stable=True)
+    spwords = sorted_all[:nw_p]
+    sowords = sorted_all[nw_p:nw_p + nw_o]
+    sp = sorted_all[nw_p + nw_o:]
+    row_idx_sorted = sp[0]
+    sdata, svalid = [], []
+    for i in range(len(distinct_cols)):
+        sdata.append(sp[1 + 2 * i])
+        svalid.append(sp[2 + 2 * i].astype(jnp.bool_))
+
+    first = jnp.zeros((n,), jnp.bool_).at[0].set(True)
+    pbounds = first
+    for w in spwords:
+        pbounds = pbounds | jnp.concatenate([first[:1], w[1:] != w[:-1]])
+    seg = jnp.cumsum(pbounds.astype(jnp.int32)) - 1
+    obounds = pbounds
+    for w in sowords:
+        obounds = obounds | jnp.concatenate([first[:1], w[1:] != w[:-1]])
+
+    idx = jnp.arange(n, dtype=jnp.int64)
+    seg_start = _seg_scan(idx, seg, lambda cur, prev: prev, jnp.int64(0))
+    row_number = (idx - seg_start + 1)
+
+    out_sorted = []
+    for col, op, k in resolved:
+        if op == "row_number":
+            out_sorted.append((INT64, row_number, None))
+        elif op == "rank":
+            # rank = row_number at the start of the tie run (forward-filled)
+            rn_at_change = jnp.where(obounds, row_number, jnp.int64(0))
+            rank = _seg_scan(rn_at_change, seg, jnp.maximum, jnp.int64(0))
+            out_sorted.append((INT64, rank, None))
+        elif op == "dense_rank":
+            d = jnp.cumsum(obounds.astype(jnp.int64))
+            d_start = _seg_scan(d, seg, lambda cur, prev: prev, jnp.int64(0))
+            out_sorted.append((INT64, d - d_start + 1, None))
+        elif op in ("lag", "lead"):
+            slot = slot_of[id(col)]
+            sval, sv = sdata[slot], svalid[slot]
+            if k == 0:
+                shifted, shv, sseg = sval, sv, seg
+            elif k >= n:  # entire partition out of range → all null
+                shifted = jnp.zeros_like(sval)
+                shv = jnp.zeros((n,), jnp.bool_)
+                sseg = jnp.full((n,), -1, jnp.int32)
+            elif op == "lag":
+                shifted = _shift_down(sval, k, jnp.zeros((), sval.dtype))
+                shv = _shift_down(sv, k, jnp.zeros((), jnp.bool_))
+                sseg = _shift_down(seg, k, jnp.int32(-1))
+            else:
+                shifted = _shift_up(sval, k, jnp.zeros((), sval.dtype))
+                shv = _shift_up(sv, k, jnp.zeros((), jnp.bool_))
+                sseg = _shift_up(seg, k, jnp.int32(-1))
+            ok = (sseg == seg) & shv
+            out_sorted.append((col.dtype, shifted, ok))
+        else:
+            slot = slot_of[id(col)]
+            c = _running(op, col, sdata[slot], svalid[slot], seg)
+            out_sorted.append((c.dtype, c.data,
+                               c.valid_mask() if c.validity is not None
+                               else None))
+
+    # ride ONE sort back to input row order (sorts over scatters)
+    back_payloads = []
+    for dtype, data, valid in out_sorted:
+        back_payloads.append(data)
+        back_payloads.append((jnp.ones((n,), jnp.bool_) if valid is None
+                              else valid).astype(jnp.uint8))
+    unsorted = jax.lax.sort((row_idx_sorted,) + tuple(back_payloads),
+                            num_keys=1, is_stable=True)[1:]
+    out_cols = []
+    for i, (dtype, _, valid) in enumerate(out_sorted):
+        data = unsorted[2 * i]
+        v = unsorted[2 * i + 1].astype(jnp.bool_)
+        out_cols.append(Column(dtype, data=data,
+                               validity=None if valid is None else v))
+
+    default_names = []
+    seen: dict = {}
+    for spec in specs:
+        ref, op, *rest = spec
+        nm = op if ref is None or not isinstance(ref, str) else f"{op}_{ref}"
+        if nm in seen:  # keep every output addressable by name
+            seen[nm] += 1
+            nm = f"{nm}_{seen[nm]}"
+        else:
+            seen[nm] = 1
+        default_names.append(nm)
+    out_names = list(names) if names is not None else default_names
+    return Table(list(table.columns) + out_cols,
+                 list(table.names or [f"c{i}" for i in
+                                      range(table.num_columns)]) + out_names)

@@ -1,0 +1,147 @@
+"""Window functions vs a pandas oracle (and Spark rank semantics)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu import dtypes as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.window import window
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    n = 5_000
+    p = rng.integers(0, 40, n)
+    o = rng.integers(0, 50, n)          # ties exist
+    v = rng.standard_normal(n) * 10
+    vvalid = rng.random(n) > 0.12
+    t = Table([Column.from_numpy(p), Column.from_numpy(o),
+               Column.from_numpy(v, validity=vvalid)], ["p", "o", "v"])
+    df = pd.DataFrame({"p": p, "o": o,
+                       "v": np.where(vvalid, v, np.nan),
+                       "row": np.arange(n)})
+    return t, df
+
+
+def _sorted_oracle(df):
+    return df.sort_values(["p", "o", "row"], kind="stable")
+
+
+def test_row_number_rank_dense_rank(data):
+    t, df = data
+    out = window(t, ["p"], ["o"], [(None, "row_number"), (None, "rank"),
+                                   (None, "dense_rank")])
+    s = _sorted_oracle(df)
+    want_rn = s.groupby("p").cumcount().to_numpy() + 1
+    got_rn = np.asarray(out["row_number"].data)[s["row"].to_numpy()]
+    assert np.array_equal(got_rn, want_rn)
+
+    want_rank = s.groupby("p")["o"].rank(method="min").astype(int)
+    got_rank = np.asarray(out["rank"].data)[s["row"].to_numpy()]
+    assert np.array_equal(got_rank, want_rank.to_numpy())
+
+    want_dr = s.groupby("p")["o"].rank(method="dense").astype(int)
+    got_dr = np.asarray(out["dense_rank"].data)[s["row"].to_numpy()]
+    assert np.array_equal(got_dr, want_dr.to_numpy())
+
+
+def test_running_sum_count_mean(data):
+    t, df = data
+    out = window(t, ["p"], ["o"], [("v", "sum"), ("v", "count"),
+                                   ("v", "mean")])
+    s = _sorted_oracle(df)
+    g = s.groupby("p")["v"]
+    want_sum = g.cumsum().to_numpy()        # pandas skips NaN
+    want_cnt = g.expanding().count().reset_index(level=0, drop=True) \
+        .to_numpy().astype(np.int64)
+    rows = s["row"].to_numpy()
+    got_sum = np.asarray(out["sum_v"].data).view(np.float64)[rows]
+    got_sum_valid = np.asarray(out["sum_v"].valid_mask())[rows]
+    want_valid = want_cnt > 0
+    assert np.array_equal(got_sum_valid, want_valid)
+    mask = want_valid & ~np.isnan(want_sum)
+    assert np.allclose(got_sum[mask], want_sum[mask], rtol=1e-12)
+    got_cnt = np.asarray(out["count_v"].data)[rows]
+    assert np.array_equal(got_cnt, want_cnt)
+    got_mean = np.asarray(out["mean_v"].data).view(np.float64)[rows]
+    want_mean = want_sum / np.maximum(want_cnt, 1)
+    assert np.allclose(got_mean[mask], want_mean[mask], rtol=1e-12)
+
+
+def test_running_min_max_int(data):
+    rng = np.random.default_rng(5)
+    n = 2_000
+    p = rng.integers(0, 10, n)
+    o = np.arange(n)
+    v = rng.integers(-1000, 1000, n)
+    t = Table([Column.from_numpy(p), Column.from_numpy(o),
+               Column.from_numpy(v)], ["p", "o", "v"])
+    out = window(t, ["p"], ["o"], [("v", "min"), ("v", "max")])
+    df = pd.DataFrame({"p": p, "o": o, "v": v, "row": np.arange(n)})
+    s = df.sort_values(["p", "o"], kind="stable")
+    rows = s["row"].to_numpy()
+    want_min = s.groupby("p")["v"].cummin().to_numpy()
+    want_max = s.groupby("p")["v"].cummax().to_numpy()
+    assert np.array_equal(np.asarray(out["min_v"].data)[rows], want_min)
+    assert np.array_equal(np.asarray(out["max_v"].data)[rows], want_max)
+
+
+def test_lag_lead(data):
+    t, df = data
+    out = window(t, ["p"], ["o"], [("v", "lag", 1), ("v", "lead", 2)])
+    s = _sorted_oracle(df)
+    rows = s["row"].to_numpy()
+    want_lag = s.groupby("p")["v"].shift(1).to_numpy()
+    want_lead = s.groupby("p")["v"].shift(-2).to_numpy()
+    got_lag = [out["lag_v"].to_pylist()[r] for r in rows]
+    got_lead = [out["lead_v"].to_pylist()[r] for r in rows]
+    for g, w in zip(got_lag, want_lag):
+        if np.isnan(w):
+            assert g is None
+        else:
+            assert g == pytest.approx(w, rel=1e-12)
+    for g, w in zip(got_lead, want_lead):
+        if np.isnan(w):
+            assert g is None
+        else:
+            assert g == pytest.approx(w, rel=1e-12)
+
+
+def test_window_inside_jit(data):
+    import jax
+    t, _ = data
+
+    @jax.jit
+    def step(tbl: Table):
+        out = window(tbl, ["p"], ["o"], [(None, "row_number"), ("v", "sum")])
+        return out["row_number"].data, out["sum_v"].data
+
+    rn, sv = step(t)
+    out = window(t, ["p"], ["o"], [(None, "row_number"), ("v", "sum")])
+    assert np.array_equal(np.asarray(rn), np.asarray(out["row_number"].data))
+
+
+def test_descending_order():
+    from spark_rapids_jni_tpu.ops.order import SortKey
+    p = np.array([1, 1, 1, 2, 2], np.int64)
+    o = np.array([10, 20, 30, 5, 7], np.int64)
+    t = Table([Column.from_numpy(p), Column.from_numpy(o)], ["p", "o"])
+    out = window(t, ["p"], [SortKey(t["o"], ascending=False)],
+                 [(None, "row_number")])
+    assert out["row_number"].to_pylist() == [3, 2, 1, 2, 1]
+
+
+def test_lag_edge_offsets():
+    p = np.array([1, 1, 1], np.int64)
+    o = np.array([1, 2, 3], np.int64)
+    v = np.array([10, 20, 30], np.int64)
+    t = Table([Column.from_numpy(p), Column.from_numpy(o),
+               Column.from_numpy(v)], ["p", "o", "v"])
+    out = window(t, ["p"], ["o"], [("v", "lag", 0), ("v", "lag", 5),
+                                   ("v", "lag", -1), (None, "count")])
+    assert out["lag_v"].to_pylist() == [10, 20, 30]       # k=0: identity
+    assert out["lag_v_2"].to_pylist() == [None] * 3       # k >= n
+    assert out["lag_v_3"].to_pylist() == [20, 30, None]   # lag(-1) == lead(1)
+    assert out["count"].to_pylist() == [1, 2, 3]          # count(*) running
