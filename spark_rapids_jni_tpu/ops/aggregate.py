@@ -96,6 +96,26 @@ def _seg_first_valid(vals, has, seg):
     return vals
 
 
+def _seg_last_valid(vals, has, seg):
+    """Forward-fill the NEAREST preceding VALID value per row (doubling).
+
+    Unlike _seg_first_valid (earliest valid wins — the whole segment sees
+    one value), this keeps the latest: a row only adopts an earlier value
+    while it has none yet.  Rows before any valid keep their payload."""
+    n = vals.shape[0]
+    shift = 1
+    while shift < n:
+        pv = _shift_down(vals, shift, jnp.zeros((), vals.dtype))
+        ph = _shift_down(has, shift, jnp.zeros((), jnp.bool_))
+        ps = _shift_down(seg, shift, jnp.int32(-1))
+        same = ps == seg
+        take_prev = same & ph & jnp.logical_not(has)
+        vals = jnp.where(take_prev, pv, vals)
+        has = jnp.where(same, has | ph, has)
+        shift *= 2
+    return vals
+
+
 def _fast_eligible(key_cols, agg_cols) -> bool:
     for c in key_cols + agg_cols:
         if c.data is None or c.dtype.is_string or c.data.ndim != 1:

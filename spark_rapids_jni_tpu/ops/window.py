@@ -12,7 +12,9 @@ Supported window ops (Spark names):
 - ``rank`` / ``dense_rank``             ties share a rank
 - ``lag`` / ``lead`` (offset k)         null outside the partition
 - ``sum`` / ``min`` / ``max`` / ``count`` / ``mean``
-  running aggregates over UNBOUNDED PRECEDING .. CURRENT ROW
+  running aggregates over Spark's default frame: RANGE UNBOUNDED
+  PRECEDING .. CURRENT ROW — rows tied on the order keys (peers) share
+  the frame value; with no order keys the frame is the whole partition
 
 All jit-safe: fixed shapes, no host syncs.
 """
@@ -35,28 +37,70 @@ def _shift_up(arr, shift: int, fill):
     return jnp.concatenate([arr[shift:], pad], axis=0)
 
 
-def _running(op: str, col: Column, sval, svalid, seg):
-    """Running aggregate over the ordered partition prefix (inclusive)."""
-    n = sval.shape[0] if sval is not None else seg.shape[0]
+def window_out_dtype(col_dtype, op: str):
+    """Result dtype of a window op (shared with parallel.distributed)."""
+    if op in ("row_number", "rank", "dense_rank", "count"):
+        return INT64
+    if op in ("lag", "lead", "min", "max"):
+        return col_dtype
+    if op == "mean":
+        return FLOAT64
+    if op == "sum":
+        if col_dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return FLOAT64
+        return col_dtype if col_dtype.is_decimal else INT64
+    raise ValueError(f"unknown window op {op!r}")
+
+
+def default_window_names(specs) -> list:
+    """Default (de-duplicated) output names (shared with distributed)."""
+    names, seen = [], {}
+    for spec in specs:
+        ref, op, *_ = spec
+        nm = op if ref is None or not isinstance(ref, str) else f"{op}_{ref}"
+        if nm in seen:
+            seen[nm] += 1
+            nm = f"{nm}_{seen[nm]}"
+        else:
+            seen[nm] = 1
+        names.append(nm)
+    return names
+
+
+def _running(op: str, col: Column, sval, svalid, seg, peer_fill):
+    """Running aggregate over the ordered partition frame.
+
+    Spark's default frame with ORDER BY is RANGE UNBOUNDED PRECEDING ..
+    CURRENT ROW: peer rows (ties on the order keys) share the frame, so
+    every prefix value is forward-filled from the END of its peer run via
+    ``peer_fill``.  With no ORDER BY the whole partition is one peer run
+    and this degenerates to the partition total — also Spark's default.
+    """
     if op == "count":
         m = svalid.astype(jnp.int64)
-        return Column(INT64, data=_seg_scan(m, seg, jnp.add,
-                                            jnp.zeros((), jnp.int64)))
+        cnt = peer_fill(_seg_scan(m, seg, jnp.add, jnp.zeros((), jnp.int64)),
+                        jnp.int64(0))
+        return Column(INT64, data=cnt)
     if op in ("sum", "mean"):
-        vf = _float64_vals(col, sval) if col.dtype.id in (
-            TypeId.FLOAT32, TypeId.FLOAT64) else sval.astype(jnp.int64)
+        if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            vf = _float64_vals(col, sval)
+        else:
+            vf = sval.astype(jnp.int64)  # decimal: unscaled; int: widened
         zero = jnp.zeros((), vf.dtype)
         m = jnp.where(svalid, vf, zero)
-        s = _seg_scan(m, seg, jnp.add, zero)
-        cnt = _seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
-                        jnp.zeros((), jnp.int64))
+        s = peer_fill(_seg_scan(m, seg, jnp.add, zero), zero)
+        cnt = peer_fill(_seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
+                                  jnp.zeros((), jnp.int64)), jnp.int64(0))
         if op == "mean":
             mean = s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(
                 jnp.float64)
+            if col.dtype.is_decimal:
+                mean = mean * (10.0 ** col.dtype.scale)
             return Column.fixed(FLOAT64, mean, validity=cnt > 0)
         if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
             return Column.fixed(FLOAT64, s, validity=cnt > 0)
-        return Column(INT64, data=s, validity=cnt > 0)
+        out = col.dtype if col.dtype.is_decimal else INT64
+        return Column(out, data=s, validity=cnt > 0)
     if op in ("min", "max"):
         if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
             from . import order as _order
@@ -64,9 +108,9 @@ def _running(op: str, col: Column, sval, svalid, seg):
             ident = jnp.uint64(2**64 - 1) if op == "min" else jnp.uint64(0)
             enc = jnp.where(svalid, enc, ident)
             combine = jnp.minimum if op == "min" else jnp.maximum
-            red = _seg_scan(enc, seg, combine, ident)
-            cnt = _seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
-                            jnp.zeros((), jnp.int64))
+            red = peer_fill(_seg_scan(enc, seg, combine, ident), ident)
+            cnt = peer_fill(_seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
+                                      jnp.zeros((), jnp.int64)), jnp.int64(0))
             data = _order.decode_minmax_bits(red, col.dtype)
             return Column(col.dtype, data=data, validity=cnt > 0)
         if jnp.issubdtype(sval.dtype, jnp.integer):
@@ -78,20 +122,24 @@ def _running(op: str, col: Column, sval, svalid, seg):
                                 sval.dtype)
         m = jnp.where(svalid, sval, ident)
         combine = jnp.minimum if op == "min" else jnp.maximum
-        red = _seg_scan(m, seg, combine, ident)
-        cnt = _seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
-                        jnp.zeros((), jnp.int64))
+        red = peer_fill(_seg_scan(m, seg, combine, ident), ident)
+        cnt = peer_fill(_seg_scan(svalid.astype(jnp.int64), seg, jnp.add,
+                                  jnp.zeros((), jnp.int64)), jnp.int64(0))
         return Column(col.dtype, data=red, validity=cnt > 0)
     raise ValueError(f"unknown window aggregate {op!r}")
 
 
 @traced("window")
 def window(table: Table, partition_by: list, order_by: list,
-           specs: list[tuple], names: list | None = None) -> Table:
+           specs: list[tuple], names: list | None = None,
+           live=None) -> Table:
     """Append window columns; rows keep their input order.
 
     ``specs``: list of (column_or_None, op) or (column, op, k) for lag/lead.
     ``order_by`` entries may be column names or SortKey for descending.
+    ``live``: optional bool[n] row mask for padded pipelines (post-shuffle
+    shards) — dead rows form their own trailing partition and produce
+    garbage outputs the caller must mask; live rows never see them.
     """
     n = table.num_rows
     pkeys = [SortKey(table.column(k)) if not isinstance(k, SortKey) else k
@@ -99,6 +147,9 @@ def window(table: Table, partition_by: list, order_by: list,
     okeys = [SortKey(table.column(k)) if not isinstance(k, SortKey) else k
              for k in order_by]
     pwords = encode_keys(pkeys)
+    if live is not None:
+        # dead rows sort last and never share a partition with live rows
+        pwords = [jnp.logical_not(live).astype(jnp.uint64)] + pwords
     owords = encode_keys(okeys)
     nw_p, nw_o = len(pwords), len(owords)
 
@@ -110,8 +161,8 @@ def window(table: Table, partition_by: list, order_by: list,
         ref, op, *rest = spec
         col = None
         if ref is None:
-            if op == "count":  # count(*) over the window == row_number
-                op = "row_number"
+            if op == "count":  # count(*): peers share the frame (RANGE)
+                op = "count_star"
             elif op not in ("row_number", "rank", "dense_rank"):
                 raise ValueError(
                     f"window op {op!r} needs a value column (got None)")
@@ -157,10 +208,24 @@ def window(table: Table, partition_by: list, order_by: list,
     seg_start = _seg_scan(idx, seg, lambda cur, prev: prev, jnp.int64(0))
     row_number = (idx - seg_start + 1)
 
+    # RANGE-frame fill: running values are shared across order-key peers by
+    # taking each peer run's END value (backward nearest-valid fill =
+    # forward nearest-valid fill on the reversed arrays — still gather-free)
+    from .aggregate import _seg_last_valid
+    is_end = jnp.concatenate([obounds[1:], jnp.ones((1,), jnp.bool_)])
+
+    def peer_fill(arr, ident):
+        rev = jnp.where(is_end, arr, ident)[::-1]
+        filled = _seg_last_valid(rev, is_end[::-1], seg[::-1])
+        return filled[::-1]
+
     out_sorted = []
     for col, op, k in resolved:
         if op == "row_number":
             out_sorted.append((INT64, row_number, None))
+        elif op == "count_star":
+            out_sorted.append((INT64, peer_fill(row_number, jnp.int64(0)),
+                               None))
         elif op == "rank":
             # rank = row_number at the start of the tie run (forward-filled)
             rn_at_change = jnp.where(obounds, row_number, jnp.int64(0))
@@ -191,7 +256,7 @@ def window(table: Table, partition_by: list, order_by: list,
             out_sorted.append((col.dtype, shifted, ok))
         else:
             slot = slot_of[id(col)]
-            c = _running(op, col, sdata[slot], svalid[slot], seg)
+            c = _running(op, col, sdata[slot], svalid[slot], seg, peer_fill)
             out_sorted.append((c.dtype, c.data,
                                c.valid_mask() if c.validity is not None
                                else None))
@@ -211,18 +276,8 @@ def window(table: Table, partition_by: list, order_by: list,
         out_cols.append(Column(dtype, data=data,
                                validity=None if valid is None else v))
 
-    default_names = []
-    seen: dict = {}
-    for spec in specs:
-        ref, op, *rest = spec
-        nm = op if ref is None or not isinstance(ref, str) else f"{op}_{ref}"
-        if nm in seen:  # keep every output addressable by name
-            seen[nm] += 1
-            nm = f"{nm}_{seen[nm]}"
-        else:
-            seen[nm] = 1
-        default_names.append(nm)
-    out_names = list(names) if names is not None else default_names
+    out_names = list(names) if names is not None \
+        else default_window_names(specs)
     return Table(list(table.columns) + out_cols,
                  list(table.names or [f"c{i}" for i in
                                       range(table.num_columns)]) + out_names)

@@ -448,6 +448,83 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
     return Table(out_cols, out_names)
 
 
+@traced("distributed_window")
+def distributed_window(table: Table, mesh: Mesh, partition_by: list,
+                       order_by: list, specs: list, names: list | None = None,
+                       axis: str = ROW_AXIS) -> Table:
+    """Distributed window functions: co-partition by the partition keys over
+    the mesh, then run ops.window shard-locally (exact — a window never
+    crosses partitions, and a partition never crosses shards).
+
+    Key lists must be column names (SortKey descending wrappers are applied
+    shard-side for ``order_by`` via (name, False) tuples).  Returns a
+    compacted host Table (row order unspecified, as in Spark).
+    """
+    from ..ops.window import window as _window
+    from .mesh import pad_to_multiple, shard_table
+    from .shuffle import shuffle_table_padded
+    ndev = mesh.shape[axis]
+    t = table
+    live = None
+    if t.num_rows % ndev:
+        t, n_orig = pad_to_multiple(t, ndev)
+        live = jnp.arange(t.num_rows, dtype=jnp.int64) < n_orig
+    st = shard_table(t, mesh, axis)
+    shuffled, ok, overflow = shuffle_table_padded(
+        st, mesh, list(partition_by), axis=axis, live=live)
+    if int(overflow):
+        raise RuntimeError(f"window shuffle overflow: {int(overflow)} rows")
+
+    names_in = tuple(shuffled.names or
+                     [f"c{i}" for i in range(shuffled.num_columns)])
+    schema = tuple(shuffled.dtypes())
+    nspecs = tuple(tuple(s) for s in specs)
+
+    def order_key(tbl, k):
+        if isinstance(k, tuple):  # (name, ascending)
+            from ..ops.order import SortKey
+            return SortKey(tbl.column(k[0]), ascending=k[1])
+        return k
+
+    def _win_shard(datas, masks, okm):
+        tbl = Table([Column(dt_, data=d, validity=m)
+                     for dt_, d, m in zip(schema, datas, masks)],
+                    list(names_in))
+        out = _window(tbl, list(partition_by),
+                      [order_key(tbl, k) for k in order_by],
+                      [tuple(s) for s in nspecs], live=okm)
+        new = out.columns[tbl.num_columns:]
+        return (tuple(c.data for c in new),
+                tuple(c.valid_mask() for c in new))
+
+    win_fn = jax.jit(shard_map(
+        _win_shard, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+
+    datas = tuple(c.data for c in shuffled.columns)
+    masks = tuple(c.validity for c in shuffled.columns)
+    wdata, wvalid = win_fn(datas, masks, ok)
+
+    keep = np.flatnonzero(np.asarray(ok))
+    out_cols = [Column(c.dtype,
+                       data=jnp.asarray(np.asarray(c.data)[keep]),
+                       validity=None if c.validity is None else
+                       jnp.asarray(np.asarray(c.validity)[keep]))
+                for c in shuffled.columns]
+    from ..ops.window import default_window_names, window_out_dtype
+    wcols = []
+    for wi, (ref, op, *rest) in enumerate(nspecs):
+        d = np.asarray(wdata[wi])[keep]
+        v = np.asarray(wvalid[wi])[keep]
+        dtype = window_out_dtype(
+            None if ref is None else shuffled.column(ref).dtype, op)
+        wcols.append(Column(dtype, data=jnp.asarray(d),
+                            validity=jnp.asarray(v)))
+    wnames = list(names) if names is not None \
+        else default_window_names(nspecs)
+    return Table(out_cols + wcols, list(names_in) + wnames)
+
+
 def agg_out_dtype(col_dtype: DType, op: str) -> DType:
     """Result dtype of an aggregation (mirrors ops.aggregate._agg_column)."""
     if op in ("count", "count_all"):

@@ -230,12 +230,16 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
 @traced("shuffle_table_padded")
 def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                          capacity: int | None = None,
-                         axis: str = ROW_AXIS, donate: bool = False):
+                         axis: str = ROW_AXIS, donate: bool = False,
+                         live=None):
     """Shuffle a row-sharded table by key hash.
 
     Returns (padded Table [ndev * ndev * capacity global rows], row mask
     Column-less bool array, overflow scalar).  Rows land on the partition
     owning pmod(murmur3(keys), ndev); padding rows have mask False.
+
+    ``live``: optional bool row mask — dead rows (e.g. pad_to_multiple
+    padding) are never sent.
 
     STRING columns (keys or payloads) cross the exchange in padded-bucket
     form (stringplane): exploded to fixed-width, shuffled inside the row
@@ -268,7 +272,7 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                       capacity, axis, donate)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
-    planes_in, ok, overflow = fn(datas, masks, None)
+    planes_in, ok, overflow = fn(datas, masks, live)
     datas_out, masks_out = _from_planes(layout, list(planes_in))
     cols = [Column(dt, data=d, validity=m)
             for dt, d, m in zip(layout.schema, datas_out, masks_out)]

@@ -48,26 +48,60 @@ def test_row_number_rank_dense_rank(data):
 
 
 def test_running_sum_count_mean(data):
+    """Spark default frame is RANGE: order-key peers share the value."""
     t, df = data
     out = window(t, ["p"], ["o"], [("v", "sum"), ("v", "count"),
                                    ("v", "mean")])
     s = _sorted_oracle(df)
-    g = s.groupby("p")["v"]
-    want_sum = g.cumsum().to_numpy()        # pandas skips NaN
-    want_cnt = g.expanding().count().reset_index(level=0, drop=True) \
-        .to_numpy().astype(np.int64)
+    # RANGE oracle: per (p, o) peer-group totals, cumulative within p,
+    # broadcast back to every peer row
+    peer = s.groupby(["p", "o"])["v"].agg(
+        psum=lambda x: x.sum(min_count=1), pcnt="count")
+    peer["csum"] = peer["psum"].fillna(0.0).groupby(level=0).cumsum()
+    peer["ccnt"] = peer["pcnt"].groupby(level=0).cumsum()
+    joined = s.join(peer[["csum", "ccnt"]], on=["p", "o"])
+    want_sum = joined["csum"].to_numpy()
+    want_cnt = joined["ccnt"].to_numpy().astype(np.int64)
     rows = s["row"].to_numpy()
     got_sum = np.asarray(out["sum_v"].data).view(np.float64)[rows]
     got_sum_valid = np.asarray(out["sum_v"].valid_mask())[rows]
     want_valid = want_cnt > 0
     assert np.array_equal(got_sum_valid, want_valid)
-    mask = want_valid & ~np.isnan(want_sum)
+    mask = want_valid
     assert np.allclose(got_sum[mask], want_sum[mask], rtol=1e-12)
     got_cnt = np.asarray(out["count_v"].data)[rows]
     assert np.array_equal(got_cnt, want_cnt)
     got_mean = np.asarray(out["mean_v"].data).view(np.float64)[rows]
     want_mean = want_sum / np.maximum(want_cnt, 1)
     assert np.allclose(got_mean[mask], want_mean[mask], rtol=1e-12)
+
+
+def test_range_frame_peers_share_values():
+    """o=[1,1]: Spark sum over (PARTITION BY p ORDER BY o) gives [30,30]."""
+    t = Table([Column.from_numpy(np.array([1, 1], np.int64)),
+               Column.from_numpy(np.array([1, 1], np.int64)),
+               Column.from_numpy(np.array([10, 20], np.int64))],
+              ["p", "o", "v"])
+    out = window(t, ["p"], ["o"], [("v", "sum"), (None, "count"),
+                                   ("v", "mean")])
+    assert out["sum_v"].to_pylist() == [30, 30]
+    assert out["count"].to_pylist() == [2, 2]
+    assert out["mean_v"].to_pylist() == [15.0, 15.0]
+
+
+def test_decimal_running_sum_keeps_scale():
+    from spark_rapids_jni_tpu import dtypes as dtm
+    t = Table([Column.from_numpy(np.array([1, 1], np.int64)),
+               Column.from_numpy(np.array([1, 2], np.int64)),
+               Column.fixed(dtm.decimal64(-2), np.array([100, 200],
+                                                        np.int64))],
+              ["p", "o", "d"])
+    out = window(t, ["p"], ["o"], [("d", "sum"), ("d", "mean")])
+    assert out["sum_d"].dtype == dtm.decimal64(-2)
+    import decimal
+    assert out["sum_d"].to_pylist() == [decimal.Decimal("1.00"),
+                                        decimal.Decimal("3.00")]
+    assert out["mean_d"].to_pylist() == [1.0, 1.5]
 
 
 def test_running_min_max_int(data):
@@ -145,3 +179,37 @@ def test_lag_edge_offsets():
     assert out["lag_v_2"].to_pylist() == [None] * 3       # k >= n
     assert out["lag_v_3"].to_pylist() == [20, 30, None]   # lag(-1) == lead(1)
     assert out["count"].to_pylist() == [1, 2, 3]          # count(*) running
+
+
+def test_distributed_window_matches_local():
+    import jax
+    from spark_rapids_jni_tpu.parallel import make_mesh, distributed_window
+    assert len(jax.devices()) >= 8
+    rng = np.random.default_rng(2)
+    n = 803  # not mesh-divisible: exercises padding + live mask
+    p = rng.integers(0, 13, n)
+    o = rng.permutation(n)  # tie-free order key: running sums well-defined
+    v = rng.standard_normal(n)
+    t = Table([Column.from_numpy(p), Column.from_numpy(o),
+               Column.from_numpy(v)], ["p", "o", "v"])
+    mesh = make_mesh(8)
+    out = distributed_window(t, mesh, ["p"], ["o"],
+                             [(None, "rank"), ("v", "sum"), ("v", "lag", 1)])
+    assert out.num_rows == n
+    df = pd.DataFrame({"p": p, "o": o, "v": v})
+    s = df.sort_values(["p", "o"], kind="stable")
+    s["rank"] = s.groupby("p")["o"].rank(method="min").astype(int)
+    s["sum"] = s.groupby("p")["v"].cumsum()
+    s["lag"] = s.groupby("p")["v"].shift(1)
+    got = pd.DataFrame({
+        "p": np.asarray(out["p"].data), "o": np.asarray(out["o"].data),
+        "rank": np.asarray(out["rank"].data),
+        "sum": np.asarray(out["sum_v"].data).view(np.float64),
+        "lag": np.where(np.asarray(out["lag_v"].valid_mask()),
+                        np.asarray(out["lag_v"].data).view(np.float64),
+                        np.nan),
+    }).sort_values(["p", "o"], kind="stable")
+    assert np.array_equal(got["rank"].to_numpy(), s["rank"].to_numpy())
+    assert np.allclose(got["sum"].to_numpy(), s["sum"].to_numpy())
+    assert np.allclose(got["lag"].to_numpy(), s["lag"].to_numpy(),
+                       equal_nan=True)
