@@ -257,6 +257,43 @@ def bench_parquet_scan(n=2_000_000):
     return decode, e2e, arrow
 
 
+def bench_window(n=2_000_000):
+    """Window rank + running sum (RANGE frame) vs single-threaded pandas."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.dtypes import INT64
+    from spark_rapids_jni_tpu.ops.window import window
+
+    rng = np.random.default_rng(4)
+    p = rng.integers(0, 10_000, n).astype(np.int64)
+    o = rng.integers(0, 1_000_000, n).astype(np.int64)
+    v = rng.integers(-1000, 1000, n).astype(np.int64)
+    pj, oj, vj = jnp.asarray(p), jnp.asarray(o), jnp.asarray(v)
+
+    def make_loop(k):
+        def body(i, carry):
+            t = Table([Column(INT64, data=pj),
+                       Column(INT64, data=oj + i),  # salt defeats hoisting
+                       Column(INT64, data=vj)], ["p", "o", "v"])
+            out = window(t, ["p"], ["o"], [(None, "rank"), ("v", "sum")])
+            return carry + out["rank"].data[0] + out["sum_v"].data[-1]
+
+        return lambda: jax.lax.fori_loop(0, k, body, jnp.int64(0))
+
+    per = fit_per_iter(make_loop, ())
+    dev_mrows = n / per / 1e6
+
+    import pandas as pd
+    df = pd.DataFrame({"p": p, "o": o, "v": v})
+    t0 = time.perf_counter()
+    s = df.sort_values(["p", "o"], kind="stable")
+    s.groupby("p")["o"].rank(method="min")
+    s.groupby("p")["v"].cumsum()
+    cpu_mrows = n / (time.perf_counter() - t0) / 1e6
+    return dev_mrows, cpu_mrows
+
+
 def bench_distributed_join(n_left=1_000_000, n_right=250_000):
     """Shuffle + distributed SortMergeJoin, BASELINE configs[3].
 
@@ -324,6 +361,7 @@ def main():
     cast_dev, cast_cpu = bench_cast_strings()
     agg_dev, agg_cpu = bench_hash_aggregate()
     scan_decode, scan_e2e, scan_arrow = bench_parquet_scan()
+    win_dev, win_cpu = bench_window()
     smj_dist, smj_local = bench_distributed_join()
 
     print(json.dumps({
@@ -343,6 +381,9 @@ def main():
                 "vs_pyarrow": round(scan_decode / scan_arrow, 3)},
             "parquet_scan_to_device_MBps": {
                 "value": round(scan_e2e, 1)},
+            "window_rank_sum_Mrows_s": {
+                "value": round(win_dev, 2),
+                "vs_cpu_pandas": round(win_dev / win_cpu, 2)},
             **({"shuffle_smj_8dev_cpu_mesh_Mrows_s": {
                 "value": round(smj_dist, 2),
                 "vs_local_single_device": round(smj_dist / smj_local, 3)}}

@@ -10,6 +10,8 @@ payload-carrying sort back to input row order — no gathers, no scatters.
 Supported window ops (Spark names):
 - ``row_number``                        1-based position in the partition
 - ``rank`` / ``dense_rank``             ties share a rank
+- ``percent_rank`` / ``cume_dist``      relative rank / cumulative share
+- ``ntile`` (buckets k)                 Spark bucket assignment
 - ``lag`` / ``lead`` (offset k)         null outside the partition
 - ``sum`` / ``min`` / ``max`` / ``count`` / ``mean``
   running aggregates over Spark's default frame: RANGE UNBOUNDED
@@ -26,7 +28,8 @@ import jax.numpy as jnp
 
 from ..columnar import Column, Table
 from ..dtypes import FLOAT64, INT64, TypeId
-from .aggregate import _float64_vals, _seg_scan, _shift_down
+from .aggregate import (_float64_vals, _seg_last_valid, _seg_scan,
+                        _shift_down)
 from .order import SortKey, encode_keys
 from ..utils.tracing import traced
 
@@ -39,11 +42,11 @@ def _shift_up(arr, shift: int, fill):
 
 def window_out_dtype(col_dtype, op: str):
     """Result dtype of a window op (shared with parallel.distributed)."""
-    if op in ("row_number", "rank", "dense_rank", "count"):
+    if op in ("row_number", "rank", "dense_rank", "count", "ntile"):
         return INT64
     if op in ("lag", "lead", "min", "max"):
         return col_dtype
-    if op == "mean":
+    if op in ("mean", "percent_rank", "cume_dist"):
         return FLOAT64
     if op == "sum":
         if col_dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
@@ -163,7 +166,8 @@ def window(table: Table, partition_by: list, order_by: list,
         if ref is None:
             if op == "count":  # count(*): peers share the frame (RANGE)
                 op = "count_star"
-            elif op not in ("row_number", "rank", "dense_rank"):
+            elif op not in ("row_number", "rank", "dense_rank",
+                            "percent_rank", "cume_dist", "ntile"):
                 raise ValueError(
                     f"window op {op!r} needs a value column (got None)")
         else:
@@ -171,6 +175,11 @@ def window(table: Table, partition_by: list, order_by: list,
             if col.dtype.is_string:
                 raise TypeError("string value columns are not supported in "
                                 "window aggregates")
+            if col.data is None or col.data.ndim != 1:
+                raise TypeError(
+                    f"window value column must be 1-D fixed-width; "
+                    f"{col.dtype!r} is not (DECIMAL128 limb pairs and "
+                    "nested columns cannot ride the sort payload)")
             if id(col) not in slot_of:
                 slot_of[id(col)] = len(distinct_cols)
                 distinct_cols.append(col)
@@ -211,13 +220,27 @@ def window(table: Table, partition_by: list, order_by: list,
     # RANGE-frame fill: running values are shared across order-key peers by
     # taking each peer run's END value (backward nearest-valid fill =
     # forward nearest-valid fill on the reversed arrays — still gather-free)
-    from .aggregate import _seg_last_valid
     is_end = jnp.concatenate([obounds[1:], jnp.ones((1,), jnp.bool_)])
 
     def peer_fill(arr, ident):
         rev = jnp.where(is_end, arr, ident)[::-1]
         filled = _seg_last_valid(rev, is_end[::-1], seg[::-1])
         return filled[::-1]
+
+    # partition size: every row adopts its partition's last row_number
+    part_size = None
+
+    def _part_size():
+        nonlocal part_size
+        if part_size is None:
+            last = jnp.concatenate([pbounds[1:], jnp.ones((1,), jnp.bool_)])
+            rev = jnp.where(last, row_number, jnp.int64(0))[::-1]
+            part_size = _seg_last_valid(rev, last[::-1], seg[::-1])[::-1]
+        return part_size
+
+    def _rank():
+        rn_at_change = jnp.where(obounds, row_number, jnp.int64(0))
+        return _seg_scan(rn_at_change, seg, jnp.maximum, jnp.int64(0))
 
     out_sorted = []
     for col, op, k in resolved:
@@ -226,11 +249,32 @@ def window(table: Table, partition_by: list, order_by: list,
         elif op == "count_star":
             out_sorted.append((INT64, peer_fill(row_number, jnp.int64(0)),
                                None))
+        elif op == "percent_rank":
+            ps = _part_size().astype(jnp.float64)
+            pr = (_rank() - 1).astype(jnp.float64) / jnp.maximum(ps - 1.0,
+                                                                 1.0)
+            out_sorted.append((FLOAT64, Column.fixed(FLOAT64, pr).data,
+                               None))
+        elif op == "cume_dist":
+            cd = peer_fill(row_number, jnp.int64(0)).astype(jnp.float64) \
+                / _part_size().astype(jnp.float64)
+            out_sorted.append((FLOAT64, Column.fixed(FLOAT64, cd).data,
+                               None))
+        elif op == "ntile":
+            # Spark NTile: first (n % k) buckets get ceil(n/k) rows
+            ps = _part_size()
+            kk = jnp.int64(k)
+            base = ps // kk
+            rem = ps % kk
+            rn0 = row_number - 1
+            big = (base + 1) * rem  # rows covered by the larger buckets
+            tile = jnp.where(
+                rn0 < big,
+                rn0 // jnp.maximum(base + 1, 1),
+                rem + (rn0 - big) // jnp.maximum(base, 1))
+            out_sorted.append((INT64, tile + 1, None))
         elif op == "rank":
-            # rank = row_number at the start of the tie run (forward-filled)
-            rn_at_change = jnp.where(obounds, row_number, jnp.int64(0))
-            rank = _seg_scan(rn_at_change, seg, jnp.maximum, jnp.int64(0))
-            out_sorted.append((INT64, rank, None))
+            out_sorted.append((INT64, _rank(), None))
         elif op == "dense_rank":
             d = jnp.cumsum(obounds.astype(jnp.int64))
             d_start = _seg_scan(d, seg, lambda cur, prev: prev, jnp.int64(0))

@@ -448,6 +448,36 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
     return Table(out_cols, out_names)
 
 
+@functools.lru_cache(maxsize=64)
+def build_distributed_window(mesh: Mesh, schema: tuple, names_in: tuple,
+                             partition_by: tuple, order_by: tuple,
+                             nspecs: tuple, axis: str = ROW_AXIS):
+    """Compile-once per-shard window program (jitted shard_map), keyed on
+    the static plan like make_shuffle / build_distributed_groupby."""
+    from ..ops.window import window as _window
+
+    def order_key(tbl, k):
+        if isinstance(k, tuple):  # (name, ascending)
+            from ..ops.order import SortKey
+            return SortKey(tbl.column(k[0]), ascending=k[1])
+        return k
+
+    def _win_shard(datas, masks, okm):
+        tbl = Table([Column(dt_, data=d, validity=m)
+                     for dt_, d, m in zip(schema, datas, masks)],
+                    list(names_in))
+        out = _window(tbl, list(partition_by),
+                      [order_key(tbl, k) for k in order_by],
+                      [tuple(s) for s in nspecs], live=okm)
+        new = out.columns[tbl.num_columns:]
+        return (tuple(c.data for c in new),
+                tuple(c.valid_mask() for c in new))
+
+    return jax.jit(shard_map(
+        _win_shard, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+
+
 @traced("distributed_window")
 def distributed_window(table: Table, mesh: Mesh, partition_by: list,
                        order_by: list, specs: list, names: list | None = None,
@@ -479,28 +509,9 @@ def distributed_window(table: Table, mesh: Mesh, partition_by: list,
                      [f"c{i}" for i in range(shuffled.num_columns)])
     schema = tuple(shuffled.dtypes())
     nspecs = tuple(tuple(s) for s in specs)
-
-    def order_key(tbl, k):
-        if isinstance(k, tuple):  # (name, ascending)
-            from ..ops.order import SortKey
-            return SortKey(tbl.column(k[0]), ascending=k[1])
-        return k
-
-    def _win_shard(datas, masks, okm):
-        tbl = Table([Column(dt_, data=d, validity=m)
-                     for dt_, d, m in zip(schema, datas, masks)],
-                    list(names_in))
-        out = _window(tbl, list(partition_by),
-                      [order_key(tbl, k) for k in order_by],
-                      [tuple(s) for s in nspecs], live=okm)
-        new = out.columns[tbl.num_columns:]
-        return (tuple(c.data for c in new),
-                tuple(c.valid_mask() for c in new))
-
-    win_fn = jax.jit(shard_map(
-        _win_shard, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis)), check_vma=False))
-
+    win_fn = build_distributed_window(mesh, schema, names_in,
+                                      tuple(partition_by), tuple(order_by),
+                                      nspecs, axis)
     datas = tuple(c.data for c in shuffled.columns)
     masks = tuple(c.validity for c in shuffled.columns)
     wdata, wvalid = win_fn(datas, masks, ok)

@@ -213,3 +213,39 @@ def test_distributed_window_matches_local():
     assert np.allclose(got["sum"].to_numpy(), s["sum"].to_numpy())
     assert np.allclose(got["lag"].to_numpy(), s["lag"].to_numpy(),
                        equal_nan=True)
+
+
+def test_percent_rank_cume_dist_ntile():
+    rng = np.random.default_rng(3)
+    n = 4_000
+    p = rng.integers(0, 17, n)
+    o = rng.integers(0, 30, n)
+    t = Table([Column.from_numpy(p), Column.from_numpy(o)], ["p", "o"])
+    out = window(t, ["p"], ["o"], [(None, "percent_rank"),
+                                   (None, "cume_dist"), (None, "ntile", 4)])
+    df = pd.DataFrame({"p": p, "o": o, "row": np.arange(n)})
+    s = df.sort_values(["p", "o", "row"], kind="stable")
+    rows = s["row"].to_numpy()
+    sizes = s.groupby("p")["o"].transform("size").to_numpy()
+    want_pr = (s.groupby("p")["o"].rank(method="min").sub(1).to_numpy()
+               / np.maximum(sizes - 1, 1))
+    got_pr = np.asarray(out["percent_rank"].data).view(np.float64)[rows]
+    assert np.allclose(got_pr, want_pr)
+    want_cd = s.groupby("p")["o"].rank(method="max").to_numpy() / sizes
+    got_cd = np.asarray(out["cume_dist"].data).view(np.float64)[rows]
+    assert np.allclose(got_cd, want_cd)
+    got_nt = np.asarray(out["ntile"].data)[rows]
+    # independent Spark-NTile oracle: build each partition's bucket vector
+    # explicitly — the first (n % k) buckets hold ceil(n/k) rows, the rest
+    # floor(n/k) — and lay it over the sorted rows
+    k = 4
+    want_parts = []
+    for _, grp in s.groupby("p", sort=True):
+        m = len(grp)
+        counts = [(m // k) + (1 if b < m % k else 0) for b in range(k)]
+        want_parts.append(np.repeat(np.arange(1, k + 1), counts))
+    # s.groupby iterates partitions in sorted p order; rows within each are
+    # already (o, row)-sorted, matching the window's ordering, so the
+    # concatenation lines up with got_nt (also in s order)
+    want_nt = np.concatenate(want_parts)
+    assert np.array_equal(got_nt, want_nt)
