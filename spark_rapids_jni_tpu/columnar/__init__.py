@@ -1,4 +1,5 @@
 from .column import Column, PackedByteColumn
 from .table import Table
+from .arrow import from_arrow, to_arrow
 
-__all__ = ["Column", "PackedByteColumn", "Table"]
+__all__ = ["Column", "PackedByteColumn", "Table", "from_arrow", "to_arrow"]

@@ -1,0 +1,165 @@
+"""pyarrow interop: Arrow Tables <-> device Tables.
+
+The Python-level twin of the bridge's shm Arrow staging (SURVEY §7: the
+JVM hands RapidsHostColumnVector buffers across; here pyarrow objects are
+the host container).  Zero-copy where Arrow's layout already matches the
+engine's (primitive buffers, string offsets+chars); validity bitmaps are
+expanded to the engine's bool masks.
+
+Supported types both ways: ints, floats, bool, string (+large_string in),
+date32, timestamps (s/ms/us/ns), decimal128 (precision <= 38), list of the
+above.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import dtypes as dt
+from .column import Column
+from .table import Table
+
+_ARROW_TO_DTYPE = {
+    "int8": dt.INT8, "int16": dt.INT16, "int32": dt.INT32, "int64": dt.INT64,
+    "uint8": dt.UINT8, "uint16": dt.UINT16, "uint32": dt.UINT32,
+    "uint64": dt.UINT64, "float": dt.FLOAT32, "double": dt.FLOAT64,
+    "bool": dt.BOOL8, "date32[day]": dt.TIMESTAMP_DAYS,
+}
+_TS_UNIT = {"s": dt.TIMESTAMP_SECONDS, "ms": dt.TIMESTAMP_MILLISECONDS,
+            "us": dt.TIMESTAMP_MICROSECONDS, "ns": dt.TIMESTAMP_NANOSECONDS}
+
+
+def _valid_mask(arr) -> np.ndarray | None:
+    if arr.null_count == 0:
+        return None
+    buf = arr.buffers()[0]
+    if buf is None:
+        return None
+    bits = np.frombuffer(buf, np.uint8)
+    mask = np.unpackbits(bits, bitorder="little")
+    off = arr.offset
+    return mask[off:off + len(arr)].astype(np.bool_)
+
+
+def from_arrow_column(arr) -> Column:
+    """One pyarrow Array/ChunkedArray -> device Column."""
+    import pyarrow as pa
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    valid = _valid_mask(arr)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        arr = arr.cast(pa.string()) if pa.types.is_large_string(t) else arr
+        bufs = arr.buffers()
+        offs = np.frombuffer(bufs[1], np.int32)[arr.offset:
+                                                arr.offset + len(arr) + 1]
+        chars = np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None \
+            else np.zeros(0, np.uint8)
+        chars = chars[offs[0]:offs[-1]]
+        return Column.string(chars, (offs - offs[0]).astype(np.int32), valid)
+    if pa.types.is_list(t):
+        offs = np.asarray(arr.offsets)
+        child = from_arrow_column(arr.values)
+        if int(offs[0]) != 0:
+            from ..ops.selection import gather_column
+            import jax.numpy as jnp
+            idx = np.arange(offs[0], offs[-1], dtype=np.int64)
+            child = gather_column(child, jnp.asarray(idx))
+            offs = offs - offs[0]
+        return Column.list_(child, offs.astype(np.int32), valid)
+    if pa.types.is_decimal(t):
+        if t.precision > 38:
+            raise NotImplementedError("decimal precision > 38")
+        ours = -t.scale
+        ints = [None if v is None else int(v.scaleb(t.scale))
+                for v in arr.to_pylist()]
+        dense = [0 if v is None else v for v in ints]
+        if t.precision <= 9:
+            return Column.fixed(dt.decimal32(ours),
+                                np.array(dense, np.int64).astype(np.int32),
+                                valid)
+        if t.precision <= 18:
+            return Column.fixed(dt.decimal64(ours),
+                                np.array(dense, np.int64), valid)
+        return Column.fixed(dt.decimal128(ours), np.array(dense, object),
+                            valid)
+    if pa.types.is_timestamp(t):
+        if t.tz not in (None, "UTC", "utc"):
+            raise NotImplementedError(
+                f"timezone-aware timestamps ({t.tz}) are not supported; "
+                "cast to UTC or naive first — engine timestamps are "
+                "timezone-less instants")
+        out = _TS_UNIT[t.unit]
+        vals = np.asarray(arr.cast(pa.int64()).fill_null(0))
+        return Column.fixed(out, vals, valid)
+    name = str(t)
+    if name in _ARROW_TO_DTYPE:
+        out = _ARROW_TO_DTYPE[name]
+        # null slots are undefined in arrow; zero-fill for the dense engine
+        # buffers (nulls are masked everywhere downstream) — fill_null also
+        # keeps numpy from materializing NaN intermediates for int arrays
+        if out.id == dt.TypeId.BOOL8:
+            vals = np.asarray(arr.cast(pa.uint8()).fill_null(0))
+        else:
+            vals = np.asarray(arr.fill_null(0) if valid is not None else arr)
+        return Column.fixed(out, vals, valid)
+    raise NotImplementedError(f"unsupported arrow type {t}")
+
+
+def from_arrow(table) -> Table:
+    """pyarrow.Table -> device Table."""
+    return Table([from_arrow_column(table.column(i))
+                  for i in range(table.num_columns)],
+                 list(table.column_names))
+
+
+def to_arrow_column(col: Column):
+    """Device Column -> pyarrow Array."""
+    import pyarrow as pa
+    valid = None if col.validity is None else col.validity_numpy()
+    mask = None if valid is None else ~valid
+    d = col.dtype
+    if d.is_string:
+        # build via offsets+chars to keep exact bytes
+        offs = np.asarray(col.offsets)
+        chars = np.asarray(col.data).tobytes()
+        vals = [chars[offs[i]:offs[i + 1]].decode() for i in range(col.size)]
+        return pa.array([None if (valid is not None and not valid[i])
+                         else vals[i] for i in range(col.size)], pa.string())
+    if d.id == dt.TypeId.LIST:
+        child = to_arrow_column(col.children[0])
+        offs = np.asarray(col.offsets, np.int32)
+        arr = pa.ListArray.from_arrays(pa.array(offs, pa.int32()), child)
+        if mask is not None:
+            # from_arrays has no mask param for all pyarrow versions: rebuild
+            pyl = arr.to_pylist()
+            return pa.array([None if mask[i] else pyl[i]
+                             for i in range(len(pyl))],
+                            pa.list_(child.type))
+        return arr
+    if d.is_decimal:
+        scale = max(-d.scale, 0)
+        prec = {dt.TypeId.DECIMAL32: 9, dt.TypeId.DECIMAL64: 18,
+                dt.TypeId.DECIMAL128: 38}[d.id]
+        return pa.array(col.to_pylist(), pa.decimal128(prec, scale))
+    if d.id == dt.TypeId.BOOL8:
+        return pa.array(np.asarray(col.data).astype(np.bool_), mask=mask)
+    if d.id == dt.TypeId.TIMESTAMP_DAYS:
+        return pa.array(np.asarray(col.data), pa.date32(), mask=mask)
+    if d.is_timestamp:
+        unit = {dt.TypeId.TIMESTAMP_SECONDS: "s",
+                dt.TypeId.TIMESTAMP_MILLISECONDS: "ms",
+                dt.TypeId.TIMESTAMP_MICROSECONDS: "us",
+                dt.TypeId.TIMESTAMP_NANOSECONDS: "ns"}[d.id]
+        return pa.array(np.asarray(col.data), pa.timestamp(unit), mask=mask)
+    if d.id == dt.TypeId.FLOAT64:
+        return pa.array(np.asarray(col.data).view(np.float64), mask=mask)
+    return pa.array(np.asarray(col.data), mask=mask)
+
+
+def to_arrow(table: Table):
+    """Device Table -> pyarrow.Table."""
+    import pyarrow as pa
+    names = list(table.names or [f"c{i}" for i in range(table.num_columns)])
+    return pa.table({nm: to_arrow_column(c)
+                     for nm, c in zip(names, table.columns)})

@@ -184,6 +184,8 @@ def window(table: Table, partition_by: list, order_by: list,
                 slot_of[id(col)] = len(distinct_cols)
                 distinct_cols.append(col)
         k = int(rest[0]) if rest else 1
+        if op == "ntile" and k < 1:
+            raise ValueError(f"NTILE bucket count must be >= 1, got {k}")
         if op in ("lag", "lead") and k < 0:  # Spark: lag(-k) == lead(k)
             op = "lead" if op == "lag" else "lag"
             k = -k
@@ -238,9 +240,15 @@ def window(table: Table, partition_by: list, order_by: list,
             part_size = _seg_last_valid(rev, last[::-1], seg[::-1])[::-1]
         return part_size
 
+    rank_cache = None
+
     def _rank():
-        rn_at_change = jnp.where(obounds, row_number, jnp.int64(0))
-        return _seg_scan(rn_at_change, seg, jnp.maximum, jnp.int64(0))
+        nonlocal rank_cache
+        if rank_cache is None:
+            rn_at_change = jnp.where(obounds, row_number, jnp.int64(0))
+            rank_cache = _seg_scan(rn_at_change, seg, jnp.maximum,
+                                   jnp.int64(0))
+        return rank_cache
 
     out_sorted = []
     for col, op, k in resolved:

@@ -490,7 +490,6 @@ def distributed_window(table: Table, mesh: Mesh, partition_by: list,
     shard-side for ``order_by`` via (name, False) tuples).  Returns a
     compacted host Table (row order unspecified, as in Spark).
     """
-    from ..ops.window import window as _window
     from .mesh import pad_to_multiple, shard_table
     from .shuffle import shuffle_table_padded
     ndev = mesh.shape[axis]

@@ -1,0 +1,68 @@
+"""pyarrow interop round trips (the host-staging twin of the bridge shm)."""
+
+import datetime
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_jni_tpu.columnar import from_arrow, to_arrow
+
+
+@pytest.fixture()
+def mixed():
+    return pa.table({
+        "i": pa.array([1, None, 3], pa.int64()),
+        "i32": pa.array([7, 8, None], pa.int32()),
+        "f": pa.array([1.5, 2.5, None], pa.float64()),
+        "f32": pa.array([0.5, None, -2.0], pa.float32()),
+        "s": pa.array(["a", None, "ccc"]),
+        "b": pa.array([True, None, False]),
+        "d": pa.array([datetime.date(2024, 1, 1), None,
+                       datetime.date(1969, 1, 1)]),
+        "ts": pa.array([1, 2, None], pa.timestamp("us")),
+        "dec": pa.array([decimal.Decimal("1.23"), None,
+                         decimal.Decimal("-4.56")], pa.decimal128(7, 2)),
+        "d128": pa.array([decimal.Decimal("123456789012345678901.2"), None,
+                          decimal.Decimal("-1.0")], pa.decimal128(25, 1)),
+        "l": pa.array([[1, 2], None, []], pa.list_(pa.int64())),
+        "ls": pa.array([["x"], [], None], pa.list_(pa.string())),
+    })
+
+
+def test_round_trip(mixed):
+    back = to_arrow(from_arrow(mixed))
+    for nm in mixed.column_names:
+        assert back[nm].to_pylist() == mixed[nm].to_pylist(), nm
+
+
+def test_sliced_input_offsets(mixed):
+    sl = mixed.slice(1, 2)
+    dev = from_arrow(sl)
+    assert dev["i"].to_pylist() == [None, 3]
+    assert dev["s"].to_pylist() == [None, "ccc"]
+    assert dev["l"].to_pylist() == [None, []]
+    assert dev["ls"].to_pylist() == [[], None]
+
+
+def test_device_ops_on_arrow_input(mixed):
+    from spark_rapids_jni_tpu.ops.aggregate import groupby
+    t = pa.table({"k": pa.array([1, 1, 2, 2], pa.int64()),
+                  "v": pa.array([10.0, 20.0, 30.0, None], pa.float64())})
+    g = groupby(from_arrow(t), ["k"], [("v", "sum")], names=["s"])
+    got = dict(zip(g["k"].to_pylist(), g["s"].to_pylist()))
+    assert got == {1: 30.0, 2: 30.0}
+
+
+def test_large_string():
+    t = pa.table({"s": pa.array(["aa", None, "b"], pa.large_string())})
+    assert from_arrow(t)["s"].to_pylist() == ["aa", None, "b"]
+
+
+def test_unicode_chunked():
+    ca = pa.chunked_array([pa.array(["héllo", "日本"]), pa.array([None, "🚀"])])
+    t = pa.table({"s": ca})
+    dev = from_arrow(t)
+    assert dev["s"].to_pylist() == ["héllo", "日本", None, "🚀"]
+    assert to_arrow(dev)["s"].to_pylist() == ["héllo", "日本", None, "🚀"]
