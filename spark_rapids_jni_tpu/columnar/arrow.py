@@ -71,18 +71,24 @@ def from_arrow_column(arr) -> Column:
         if t.precision > 38:
             raise NotImplementedError("decimal precision > 38")
         ours = -t.scale
-        ints = [None if v is None else int(v.scaleb(t.scale))
-                for v in arr.to_pylist()]
-        dense = [0 if v is None else v for v in ints]
-        if t.precision <= 9:
-            return Column.fixed(dt.decimal32(ours),
-                                np.array(dense, np.int64).astype(np.int32),
-                                valid)
+        # unscaled values are little-endian int128 limb pairs in the value
+        # buffer: read them vectorized (no per-row Decimal objects)
+        n = len(arr)
+        limbs = np.frombuffer(arr.buffers()[1], np.int64)
+        limbs = limbs[arr.offset * 2:(arr.offset + n) * 2].reshape(n, 2)
         if t.precision <= 18:
-            return Column.fixed(dt.decimal64(ours),
-                                np.array(dense, np.int64), valid)
-        return Column.fixed(dt.decimal128(ours), np.array(dense, object),
-                            valid)
+            # in-range values are sign extensions of the low limb
+            lo = limbs[:, 0].copy()
+            if valid is not None:
+                lo[~valid] = 0
+            if t.precision <= 9:
+                return Column.fixed(dt.decimal32(ours),
+                                    lo.astype(np.int32), valid)
+            return Column.fixed(dt.decimal64(ours), lo, valid)
+        pairs = limbs.copy()
+        if valid is not None:
+            pairs[~valid] = 0
+        return Column.fixed(dt.decimal128(ours), pairs, valid)
     if pa.types.is_timestamp(t):
         if t.tz not in (None, "UTC", "utc"):
             raise NotImplementedError(
@@ -120,12 +126,7 @@ def to_arrow_column(col: Column):
     mask = None if valid is None else ~valid
     d = col.dtype
     if d.is_string:
-        # build via offsets+chars to keep exact bytes
-        offs = np.asarray(col.offsets)
-        chars = np.asarray(col.data).tobytes()
-        vals = [chars[offs[i]:offs[i + 1]].decode() for i in range(col.size)]
-        return pa.array([None if (valid is not None and not valid[i])
-                         else vals[i] for i in range(col.size)], pa.string())
+        return pa.array(col.to_pylist(), pa.string())
     if d.id == dt.TypeId.LIST:
         child = to_arrow_column(col.children[0])
         offs = np.asarray(col.offsets, np.int32)
@@ -161,5 +162,4 @@ def to_arrow(table: Table):
     """Device Table -> pyarrow.Table."""
     import pyarrow as pa
     names = list(table.names or [f"c{i}" for i in range(table.num_columns)])
-    return pa.table({nm: to_arrow_column(c)
-                     for nm, c in zip(names, table.columns)})
+    return pa.table([to_arrow_column(c) for c in table.columns], names=names)

@@ -17,6 +17,9 @@ Supported window ops (Spark names):
   running aggregates over Spark's default frame: RANGE UNBOUNDED
   PRECEDING .. CURRENT ROW — rows tied on the order keys (peers) share
   the frame value; with no order keys the frame is the whole partition
+- ``rolling_sum`` / ``rolling_count`` / ``rolling_mean`` (window w):
+  ROWS BETWEEN w-1 PRECEDING AND CURRENT ROW, via prefix differences
+  (cudf::rolling_window's bounded-ROWS shape)
 
 All jit-safe: fixed shapes, no host syncs.
 """
@@ -48,10 +51,14 @@ def window_out_dtype(col_dtype, op: str):
         return col_dtype
     if op in ("mean", "percent_rank", "cume_dist"):
         return FLOAT64
-    if op == "sum":
+    if op in ("sum", "rolling_sum"):
         if col_dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
             return FLOAT64
         return col_dtype if col_dtype.is_decimal else INT64
+    if op == "rolling_count":
+        return INT64
+    if op == "rolling_mean":
+        return FLOAT64
     raise ValueError(f"unknown window op {op!r}")
 
 
@@ -186,6 +193,8 @@ def window(table: Table, partition_by: list, order_by: list,
         k = int(rest[0]) if rest else 1
         if op == "ntile" and k < 1:
             raise ValueError(f"NTILE bucket count must be >= 1, got {k}")
+        if op.startswith("rolling_") and k < 1:
+            raise ValueError(f"rolling window size must be >= 1, got {k}")
         if op in ("lag", "lead") and k < 0:  # Spark: lag(-k) == lead(k)
             op = "lead" if op == "lag" else "lag"
             k = -k
@@ -306,6 +315,68 @@ def window(table: Table, partition_by: list, order_by: list,
                 sseg = _shift_up(seg, k, jnp.int32(-1))
             ok = (sseg == seg) & shv
             out_sorted.append((col.dtype, shifted, ok))
+        elif op in ("rolling_sum", "rolling_count", "rolling_mean"):
+            # ROWS-frame bounded window via prefix differences: the sum over
+            # [i-k+1, i] is ps[i] - ps[i-k], with rows from another segment
+            # contributing their prefix AT the segment boundary... which is
+            # exactly what subtracting the shifted-from-other-segment prefix
+            # would get wrong — so shift both the prefix and its segment id
+            # and fall back to the segment-start prefix when i-k crosses it.
+            slot = slot_of[id(col)]
+            sval, sv = sdata[slot], svalid[slot]
+            is_float = col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64)
+            vf = _float64_vals(col, sval) if is_float \
+                else sval.astype(jnp.int64)
+            zero = jnp.zeros((), vf.dtype)
+            kk = min(k, n)
+
+            def windowed(contrib, ident):
+                """Σ contrib over the last kk rows of the same segment, via
+                per-segment inclusive prefixes (prefix before a segment
+                start is identically 0, so the boundary base is just 0)."""
+                ps_ = _seg_scan(contrib, seg, jnp.add, ident)
+                if kk == 0:
+                    return ps_
+                pk = _shift_down(ps_, kk, ident)
+                sk = _shift_down(seg, kk, jnp.int32(-1))
+                return ps_ - jnp.where(sk == seg, pk,
+                                       jnp.zeros((), ps_.dtype))
+
+            if is_float:
+                # isolate non-finite values so a NaN/Inf only affects the
+                # windows that actually contain it (prefix differences would
+                # otherwise poison every later window: NaN - NaN = NaN)
+                finite = jnp.isfinite(vf)
+                rsum = windowed(jnp.where(sv & finite, vf, zero), zero)
+                nan_w = windowed((sv & jnp.isnan(vf)).astype(jnp.int64),
+                                 jnp.int64(0))
+                pinf_w = windowed((sv & jnp.isposinf(vf)).astype(jnp.int64),
+                                  jnp.int64(0))
+                ninf_w = windowed((sv & jnp.isneginf(vf)).astype(jnp.int64),
+                                  jnp.int64(0))
+                rsum = jnp.where(pinf_w > 0, jnp.inf, rsum)
+                rsum = jnp.where(ninf_w > 0, -jnp.inf, rsum)
+                rsum = jnp.where((nan_w > 0) | ((pinf_w > 0) & (ninf_w > 0)),
+                                 jnp.nan, rsum)
+            else:
+                rsum = windowed(jnp.where(sv, vf, zero), zero)
+            rcnt = windowed(sv.astype(jnp.int64), jnp.int64(0))
+            if op == "rolling_count":
+                out_sorted.append((INT64, rcnt, None))
+            elif op == "rolling_sum":
+                if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+                    c_ = Column.fixed(FLOAT64, rsum, validity=rcnt > 0)
+                    out_sorted.append((FLOAT64, c_.data, rcnt > 0))
+                else:
+                    out = col.dtype if col.dtype.is_decimal else INT64
+                    out_sorted.append((out, rsum, rcnt > 0))
+            else:
+                mean = rsum.astype(jnp.float64) / jnp.maximum(
+                    rcnt, 1).astype(jnp.float64)
+                if col.dtype.is_decimal:
+                    mean = mean * (10.0 ** col.dtype.scale)
+                c_ = Column.fixed(FLOAT64, mean, validity=rcnt > 0)
+                out_sorted.append((FLOAT64, c_.data, rcnt > 0))
         else:
             slot = slot_of[id(col)]
             c = _running(op, col, sdata[slot], svalid[slot], seg, peer_fill)

@@ -66,3 +66,22 @@ def test_unicode_chunked():
     dev = from_arrow(t)
     assert dev["s"].to_pylist() == ["héllo", "日本", None, "🚀"]
     assert to_arrow(dev)["s"].to_pylist() == ["héllo", "日本", None, "🚀"]
+
+
+def test_to_arrow_duplicate_names():
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    t = Table([Column.from_numpy(np.array([1, 2], np.int64)),
+               Column.from_numpy(np.array([3, 4], np.int64))], ["x", "x"])
+    back = to_arrow(t)
+    assert back.num_columns == 2
+    assert back.column(0).to_pylist() == [1, 2]
+    assert back.column(1).to_pylist() == [3, 4]
+
+
+def test_decimal_buffer_ingest_large():
+    import decimal
+    n = 50_000
+    vals = [decimal.Decimal(i) / 100 for i in range(-n // 2, n // 2)]
+    t = pa.table({"d": pa.array(vals, pa.decimal128(12, 2))})
+    dev = from_arrow(t)
+    assert dev["d"].to_pylist() == vals

@@ -249,3 +249,62 @@ def test_percent_rank_cume_dist_ntile():
     # concatenation lines up with got_nt (also in s order)
     want_nt = np.concatenate(want_parts)
     assert np.array_equal(got_nt, want_nt)
+
+
+def test_rolling_sum_count_mean():
+    rng = np.random.default_rng(7)
+    n = 3_000
+    p = rng.integers(0, 12, n)
+    o = rng.permutation(n)
+    v = rng.standard_normal(n)
+    vvalid = rng.random(n) > 0.1
+    t = Table([Column.from_numpy(p), Column.from_numpy(o),
+               Column.from_numpy(v, validity=vvalid)], ["p", "o", "v"])
+    w = 5
+    out = window(t, ["p"], ["o"], [("v", "rolling_sum", w),
+                                   ("v", "rolling_count", w),
+                                   ("v", "rolling_mean", w)])
+    df = pd.DataFrame({"p": p, "o": o,
+                       "v": np.where(vvalid, v, np.nan),
+                       "row": np.arange(n)})
+    s = df.sort_values(["p", "o"], kind="stable")
+    g = s.groupby("p")["v"].rolling(w, min_periods=1)
+    want_sum = g.sum().reset_index(level=0, drop=True).sort_index().to_numpy()
+    want_cnt = g.count().reset_index(level=0, drop=True).sort_index() \
+        .to_numpy().astype(np.int64)
+    got_sum = np.asarray(out["rolling_sum_v"].data).view(np.float64)
+    got_cnt = np.asarray(out["rolling_count_v"].data)
+    got_mean = np.asarray(out["rolling_mean_v"].data).view(np.float64)
+    # want_* are indexed by original row after sort_index
+    mask = want_cnt > 0
+    assert np.array_equal(got_cnt, want_cnt)
+    assert np.allclose(got_sum[mask], np.nan_to_num(want_sum)[mask],
+                       rtol=1e-12)
+    assert np.allclose(got_mean[mask],
+                       np.nan_to_num(want_sum)[mask] / want_cnt[mask],
+                       rtol=1e-12)
+    # validity: windows with zero valid values are null
+    assert np.array_equal(np.asarray(out["rolling_sum_v"].valid_mask()),
+                          mask)
+
+
+def test_rolling_int_exact():
+    t = Table([Column.from_numpy(np.array([1] * 6, np.int64)),
+               Column.from_numpy(np.arange(6, dtype=np.int64)),
+               Column.from_numpy(np.array([1, 2, 3, 4, 5, 6], np.int64))],
+              ["p", "o", "v"])
+    out = window(t, ["p"], ["o"], [("v", "rolling_sum", 3)])
+    assert out["rolling_sum_v"].to_pylist() == [1, 3, 6, 9, 12, 15]
+
+
+def test_rolling_nan_isolated_to_containing_windows():
+    p = np.array([0, 0, 0, 1, 1, 1], np.int64)
+    o = np.arange(6, dtype=np.int64)
+    v = np.array([1.0, np.nan, 2.0, 10.0, 20.0, 30.0])
+    t = Table([Column.from_numpy(p), Column.from_numpy(o),
+               Column.from_numpy(v)], ["p", "o", "v"])
+    out = window(t, ["p"], ["o"], [("v", "rolling_sum", 2)])
+    got = out["rolling_sum_v"].to_pylist()
+    assert got[0] == 1.0
+    assert np.isnan(got[1]) and np.isnan(got[2])  # windows containing NaN
+    assert got[3:] == [10.0, 30.0, 50.0]          # other partition untouched
