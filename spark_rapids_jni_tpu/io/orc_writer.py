@@ -282,14 +282,24 @@ def _column_streams(col, dtype: dt.DType) -> list[tuple[int, bytes]]:
     return streams
 
 
+try:
+    import pyarrow as _pa
+    _SNAPPY_C = _pa.Codec("snappy")  # compressor (decoder lives in io.snappy)
+except Exception:  # pragma: no cover - pyarrow is baked into this env
+    _SNAPPY_C = None
+
+
 def _compress_stream(raw: bytes, kind: int, block: int) -> bytes:
     if kind == COMP_NONE:
         return raw
     out = bytearray()
     for i in range(0, len(raw), block):
         chunk = raw[i:i + block]
-        comp = zlib.compressobj(6, zlib.DEFLATED, -15)
-        cb = comp.compress(chunk) + comp.flush()
+        if kind == COMP_ZLIB:
+            comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+            cb = comp.compress(chunk) + comp.flush()
+        else:  # COMP_SNAPPY
+            cb = _SNAPPY_C.compress(chunk).to_pybytes()
         if len(cb) < len(chunk):
             h = len(cb) << 1
             out += bytes([h & 0xFF, (h >> 8) & 0xFF, (h >> 16) & 0xFF])
@@ -304,8 +314,11 @@ def _compress_stream(raw: bytes, kind: int, block: int) -> bytes:
 def write_orc(table: Table, path, compression: str = "none",
               stripe_rows: int = 1 << 20):
     """Write a Table as an ORC 0.12 file readable by any ORC reader."""
-    comp = {"none": COMP_NONE, "uncompressed": COMP_NONE,
-            "zlib": COMP_ZLIB}[compression.lower()]
+    kinds = {"none": COMP_NONE, "uncompressed": COMP_NONE,
+             "zlib": COMP_ZLIB}
+    if _SNAPPY_C is not None:
+        kinds["snappy"] = COMP_SNAPPY
+    comp = kinds[compression.lower()]
     block = 64 * 1024
     names = [nm or f"c{i}" for i, nm in enumerate(
         table.names or [f"c{i}" for i in range(table.num_columns)])]
