@@ -185,6 +185,10 @@ def _int_rle_v1(buf: bytes, n: int, signed: bool) -> np.ndarray:
             base, pos = _uvarint(buf, pos)
             if signed:
                 base = (base >> 1) ^ -(base & 1)
+            # wrap to int64 exactly like the literal path: an unsigned
+            # varint base >= 2**63 (e.g. two's-complement negative nanos
+            # emitted as a run) must not overflow the int64 assignment
+            base = int(np.int64(np.uint64(base & (2**64 - 1))))
             out[total:total + run] = base + delta * np.arange(run, dtype=np.int64)
             total += run
         else:  # 256-h literal varints

@@ -18,7 +18,7 @@ import numpy as np
 from .. import dtypes as dt
 from ..columnar import Table
 from ..ops.selection import gather_column
-from .orc import (COMP_NONE, COMP_ZLIB, SK_DATA, SK_LENGTH, SK_PRESENT,
+from .orc import (COMP_NONE, COMP_SNAPPY, COMP_ZLIB, SK_DATA, SK_LENGTH, SK_PRESENT,
                   SK_SECONDARY, TK_BOOLEAN, TK_BYTE, TK_DATE, TK_DECIMAL,
                   TK_DOUBLE, TK_FLOAT, TK_INT, TK_LONG, TK_SHORT, TK_STRING,
                   TK_STRUCT, TK_TIMESTAMP, _ORC_EPOCH_S)

@@ -6,6 +6,8 @@ follow Spark SQL:
 
 - null in → null out (except null-safe equality and AND/OR short-circuit
   truth tables);
+- float comparisons use Spark's NaN ordering, not IEEE: NaN == NaN is
+  true (also under ``<=>``) and NaN sorts greater than any other double;
 - integer division/modulo by zero → null (Spark returns null, not error);
 - FLOAT64 columns store bit patterns (dtypes.device_storage), so float
   arithmetic round-trips through utils.floatbits;
@@ -116,51 +118,78 @@ def modulo(a: Column, b: Column) -> Column:
     return _result(INT64, r, valid)
 
 
-def _compare(a: Column, b: Column, fn) -> Column:
+def _is_float(a: Column, b: Column) -> bool:
+    return a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64) or \
+        b.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64)
+
+
+def _nan_eq(av, bv):
+    """Spark equality over doubles: NaN == NaN is true.
+
+    IEEE ``==`` is already false whenever either side is NaN, so Spark's
+    table is the IEEE result plus the both-NaN case."""
+    return jnp.equal(av, bv) | (jnp.isnan(av) & jnp.isnan(bv))
+
+
+def _nan_lt(av, bv):
+    """Spark ordering over doubles: NaN is greater than everything else."""
+    return jnp.less(av, bv) | (jnp.isnan(bv) & ~jnp.isnan(av))
+
+
+def _compare(a: Column, b: Column, fn, nan_fn=None) -> Column:
     av, bv = _vals(a), _vals(b)
-    if a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64) or \
-            b.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+    if _is_float(a, b):
         av = av.astype(jnp.float64)
         bv = bv.astype(jnp.float64)
+        if nan_fn is not None:
+            fn = nan_fn
     return _result(BOOL8, fn(av, bv), _both_valid(a, b))
 
 
 @traced("binary_op")
 def eq(a: Column, b: Column) -> Column:
-    return _compare(a, b, jnp.equal)
+    return _compare(a, b, jnp.equal, _nan_eq)
 
 
 @traced("binary_op")
 def ne(a: Column, b: Column) -> Column:
-    return _compare(a, b, jnp.not_equal)
+    return _compare(a, b, jnp.not_equal, lambda x, y: ~_nan_eq(x, y))
 
 
 @traced("binary_op")
 def lt(a: Column, b: Column) -> Column:
-    return _compare(a, b, jnp.less)
+    return _compare(a, b, jnp.less, _nan_lt)
 
 
 @traced("binary_op")
 def le(a: Column, b: Column) -> Column:
-    return _compare(a, b, jnp.less_equal)
+    # a <= b: IEEE result, plus "b is NaN" (NaN is the maximum, and equals
+    # itself, so any a satisfies a <= NaN)
+    return _compare(a, b, jnp.less_equal,
+                    lambda x, y: jnp.less_equal(x, y) | jnp.isnan(y))
 
 
 @traced("binary_op")
 def gt(a: Column, b: Column) -> Column:
-    return _compare(a, b, jnp.greater)
+    return _compare(a, b, jnp.greater, lambda x, y: _nan_lt(y, x))
 
 
 @traced("binary_op")
 def ge(a: Column, b: Column) -> Column:
-    return _compare(a, b, jnp.greater_equal)
+    return _compare(a, b, jnp.greater_equal,
+                    lambda x, y: jnp.greater_equal(x, y) | jnp.isnan(x))
 
 
 @traced("binary_op")
 def eq_null_safe(a: Column, b: Column) -> Column:
     """Spark ``<=>``: nulls compare equal; never returns null."""
     av, bv = _vals(a), _vals(b)
+    if _is_float(a, b):
+        same_v = _nan_eq(av.astype(jnp.float64), bv.astype(jnp.float64))
+    else:
+        same_v = jnp.equal(av, bv)
     va, vb = a.valid_mask(), b.valid_mask()
-    same = jnp.equal(av, bv) & va & vb
+    same = same_v & va & vb
     both_null = ~va & ~vb
     return Column(BOOL8, data=(same | both_null).astype(jnp.uint8))
 

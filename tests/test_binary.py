@@ -105,3 +105,27 @@ def test_distinct_unnamed_table():
     t = Table([Column.from_numpy(np.array([3, 3, 1], np.int64))])
     d = distinct(t)
     assert d.columns[0].to_pylist() == [3, 1]
+
+
+def test_spark_nan_comparison_semantics():
+    """Spark SQL: NaN == NaN is true; NaN is greater than any other double
+    (ADVICE r3: IEEE semantics previously leaked through eq/lt/gt/<=>)."""
+    from spark_rapids_jni_tpu.ops import ge, gt, le, ne
+    nan = float("nan")
+    a = col([nan, nan, 1.0, nan])
+    b = col([nan, 1.0, nan, 2.0])
+    assert eq(a, b).to_pylist() == [True, False, False, False]
+    assert ne(a, b).to_pylist() == [False, True, True, True]
+    assert lt(a, b).to_pylist() == [False, False, True, False]
+    assert le(a, b).to_pylist() == [True, False, True, False]
+    assert gt(a, b).to_pylist() == [False, True, False, True]
+    assert ge(a, b).to_pylist() == [True, True, False, True]
+    assert eq_null_safe(a, b).to_pylist() == [True, False, False, False]
+
+
+def test_spark_nan_with_nulls():
+    nan = float("nan")
+    a = col([nan, nan], valid=[1, 0])
+    b = col([nan, nan], valid=[1, 1])
+    assert eq(a, b).to_pylist() == [True, None]
+    assert eq_null_safe(a, b).to_pylist() == [True, False]

@@ -122,3 +122,21 @@ def test_empty_and_all_null(tmp_path):
     p2 = tmp_path / "n.orc"
     write_orc(t2, p2)
     assert porc.ORCFile(p2).read()["x"].to_pylist() == [None] * 3
+
+
+def test_pre1970_timestamp_run_rle_base_overflow(tmp_path):
+    """Three+ identical pre-1970 fractional-second timestamps emit the
+    negative nanos as an RLEv1 *run* whose unsigned varint base is >= 2**63;
+    the reader must wrap it to int64 instead of raising OverflowError
+    (ADVICE r3 medium, io/orc.py RLEv1 run path)."""
+    vals = [-1_500] * 5  # ms precision, fractional second before the epoch
+    t = Table([Column.fixed(dt.TIMESTAMP_MILLISECONDS,
+                            np.array(vals, np.int64))], ["ts"])
+    p = tmp_path / "neg_run.orc"
+    write_orc(t, p)
+    # pyarrow reads the file fine (file is valid ORC) ...
+    back = porc.ORCFile(p).read()
+    assert [g.value for g in back["ts"].to_pylist()] == \
+        [v * 10**6 for v in vals]
+    # ... and so must the engine's own reader
+    assert read_orc(p)["ts"].to_pylist() == [v * 10**6 for v in vals]
