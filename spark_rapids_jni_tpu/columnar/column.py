@@ -157,6 +157,20 @@ class Column:
         valid = np.array([v is not None for v in values], np.bool_)
         has_nulls = not valid.all()
         non_null = [v for v in values if v is not None]
+        if (dtype is None or dtype.id == TypeId.LIST) and non_null and \
+                isinstance(non_null[0], (list, tuple)):
+            # LIST rows: recurse on the flattened elements (null rows get
+            # empty ranges, the standard Arrow convention)
+            lens = np.fromiter((len(v) if v is not None else 0
+                                for v in values), np.int64, n)
+            offsets = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            if offsets[-1] > np.iinfo(np.int32).max:
+                raise OverflowError("list column exceeds int32 offsets")
+            flat = [e for v in values if v is not None for e in v]
+            child = Column.from_pylist(flat)
+            return Column.list_(child, offsets.astype(np.int32),
+                                valid if has_nulls else None)
         if dtype is not None and dtype.is_string or (
             dtype is None and non_null and isinstance(non_null[0], (str, bytes))
         ):
@@ -294,6 +308,12 @@ class Column:
                 valid = valid & indices_valid
             return Column(self.dtype, validity=valid, children=kids)
         indices = jnp.asarray(indices)
+        if self.data.shape[0] == 0:
+            # empty source (routine for empty join partitions): every gather
+            # row is null; jnp.take cannot clip into an empty axis
+            shape = (indices.shape[0],) + self.data.shape[1:]
+            return Column(self.dtype, data=jnp.zeros(shape, self.data.dtype),
+                          validity=jnp.zeros((indices.shape[0],), jnp.bool_))
         # cudf out_of_bounds_policy::NULLIFY: OOB indices produce null rows
         valid = (indices >= 0) & (indices < self.data.shape[0])
         data = jnp.take(self.data, indices, axis=0, mode="clip")

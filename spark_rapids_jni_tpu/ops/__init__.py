@@ -28,7 +28,8 @@ from .selection import (  # noqa: F401
 )
 from .aggregate import groupby  # noqa: F401
 from .join import (  # noqa: F401
-    inner_join, left_join, left_semi_join, left_anti_join,
+    inner_join, left_join, right_join, full_join, cross_join,
+    left_semi_join, left_anti_join, sort_merge_join,
 )
 from .binary import (  # noqa: F401
     add, subtract, multiply, true_divide, floor_div, modulo,

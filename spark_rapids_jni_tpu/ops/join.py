@@ -1,4 +1,4 @@
-"""Equi-joins: inner / left / left-semi / left-anti (+ SortMergeJoin surface).
+"""Equi-joins: inner/left/right/full-outer/cross/left-semi/left-anti.
 
 TPU-native replacement for cudf's hash joins (the SortMergeJoin/ShuffledHashJoin
 targets in BASELINE.json configs[3]).  Open-addressing hash tables don't
@@ -191,8 +191,10 @@ def _candidates(left: Table, right: Table, on_left, on_right):
     lk = _key_table(left, on_left)
     rk = _key_table(right, on_right)
     # string keys size their padded matrices on the host (to_padded_bytes),
-    # so the string path runs its stages eagerly
-    has_string = any(c.dtype.is_string for c in lk.columns)
+    # so the string path runs its stages eagerly (either side may be the
+    # string one, e.g. joining an empty untyped partition against strings)
+    has_string = any(c.dtype.is_string
+                     for c in list(lk.columns) + list(rk.columns))
     if has_string:
         lh = xxhash64(lk).data
         rh = xxhash64(rk).data
@@ -303,6 +305,64 @@ def left_join(left: Table, right: Table, on_left, on_right=None,
                      right_valid=ri_all >= 0)
 
 
+@traced("right_join")
+def right_join(left: Table, right: Table, on_left, on_right=None,
+               suffixes=("", "_r")) -> Table:
+    """Right outer equi-join (cudf::right_join role, SURVEY §2.2).
+
+    Output shape follows the engine convention (left columns then right
+    non-key columns); key columns are coalesced so unmatched right rows
+    carry the right side's key values, matching the pandas/Spark oracle."""
+    from .selection import nonzero_indices
+    on_right = on_right or on_left
+    li, ri, eq, _, _ = _candidates(left, right, on_left, on_right)
+    matched_r = jnp.zeros((right.num_rows,), jnp.bool_)
+    if ri.shape[0]:
+        matched_r = matched_r.at[ri].max(eq)
+    li_m, ri_m = _compact_pairs(li, ri, eq)
+    un = nonzero_indices(~matched_r)
+    li_all = jnp.concatenate([li_m, jnp.full(un.shape, -1, _I32)])
+    ri_all = jnp.concatenate([ri_m, un]).astype(_I32)
+    return _assemble_outer(left, right, li_all, ri_all, on_left, on_right,
+                           suffixes, left_valid=li_all >= 0, right_valid=None)
+
+
+@traced("full_join")
+def full_join(left: Table, right: Table, on_left, on_right=None,
+              suffixes=("", "_r")) -> Table:
+    """Full outer equi-join (cudf::full_join role, SURVEY §2.2): matched
+    pairs, then unmatched left rows (right side null), then unmatched right
+    rows (left side null, keys coalesced from the right)."""
+    from .selection import nonzero_indices
+    on_right = on_right or on_left
+    li, ri, eq, _, _ = _candidates(left, right, on_left, on_right)
+    matched_l = jnp.zeros((left.num_rows,), jnp.bool_)
+    matched_r = jnp.zeros((right.num_rows,), jnp.bool_)
+    if li.shape[0]:
+        matched_l = matched_l.at[li].max(eq)
+        matched_r = matched_r.at[ri].max(eq)
+    li_m, ri_m = _compact_pairs(li, ri, eq)
+    ul = nonzero_indices(~matched_l)
+    ur = nonzero_indices(~matched_r)
+    li_all = jnp.concatenate(
+        [li_m, ul, jnp.full(ur.shape, -1, _I32)]).astype(_I32)
+    ri_all = jnp.concatenate(
+        [ri_m, jnp.full(ul.shape, -1, _I32), ur]).astype(_I32)
+    return _assemble_outer(left, right, li_all, ri_all, on_left, on_right,
+                           suffixes, left_valid=li_all >= 0,
+                           right_valid=ri_all >= 0)
+
+
+@traced("cross_join")
+def cross_join(left: Table, right: Table, suffixes=("", "_r")) -> Table:
+    """Cartesian product (cudf::cross_join role): every left row paired with
+    every right row, left-major order; all columns of both sides kept."""
+    nl, nr = left.num_rows, right.num_rows
+    li = jnp.repeat(jnp.arange(nl, dtype=_I32), nr)
+    ri = jnp.tile(jnp.arange(nr, dtype=_I32), nl)
+    return _assemble(left, right, li, ri, (), (), suffixes, right_valid=None)
+
+
 def _distinct_reps(table: Table, on):
     """(representative-row index array, group id per row) for the key columns.
 
@@ -385,6 +445,41 @@ def _assemble_body(left, right, li, ri, on_right, suffixes, right_valid):
     return Table(list(lcols.columns) + list(rcols.columns), names)
 
 
+def _assemble_outer(left, right, li, ri, on_left, on_right, suffixes,
+                    left_valid, right_valid):
+    """Assemble an outer join where either side's row index may be -1.
+
+    Key columns are coalesced — a row missing on the left takes the right
+    side's key value (concat + single gather so STRING/nested keys work the
+    same as fixed-width)."""
+    from .selection import gather_column, _concat_columns
+    on_left = list(on_left)
+    on_right = list(on_right if on_right is not None else on_left)
+    lnames = list(left.names or [f"l{i}" for i in range(left.num_columns)])
+    rnames = list(right.names or [f"c{i}" for i in range(right.num_columns)])
+    nl = left.num_rows
+    out_cols, out_names = [], []
+    for nm, col in zip(lnames, left.columns):
+        if nm in on_left and left_valid is not None:
+            rk = right.column(on_right[on_left.index(nm)])
+            both = _concat_columns([col, rk])
+            idx = jnp.where(left_valid, jnp.clip(li, 0, max(nl - 1, 0)),
+                            nl + jnp.clip(ri, 0, max(right.num_rows - 1, 0)))
+            out_cols.append(gather_column(both, idx))
+        else:
+            out_cols.append(gather_column(col, jnp.clip(li, 0, max(nl - 1, 0)),
+                                          indices_valid=left_valid))
+        out_names.append(nm)
+    for nm, col in zip(rnames, right.columns):
+        if nm in on_right:
+            continue
+        out_cols.append(gather_column(
+            col, jnp.clip(ri, 0, max(right.num_rows - 1, 0)),
+            indices_valid=right_valid))
+        out_names.append(nm + (suffixes[1] if nm in lnames else ""))
+    return Table(out_cols, out_names)
+
+
 @traced("sort_merge_join")
 def sort_merge_join(left: Table, right: Table, on_left, on_right=None,
                     how: str = "inner") -> Table:
@@ -395,6 +490,12 @@ def sort_merge_join(left: Table, right: Table, on_left, on_right=None,
         return inner_join(left, right, on_left, on_right)
     if how == "left":
         return left_join(left, right, on_left, on_right)
+    if how == "right":
+        return right_join(left, right, on_left, on_right)
+    if how in ("full", "outer", "full_outer"):
+        return full_join(left, right, on_left, on_right)
+    if how == "cross":
+        return cross_join(left, right)
     if how == "semi":
         return left_semi_join(left, right, on_left, on_right)
     if how == "anti":

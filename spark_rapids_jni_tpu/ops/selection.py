@@ -41,6 +41,11 @@ def gather_column(col: Column, indices, indices_valid=None) -> Column:
     if not col.dtype.is_string:
         return col.gather(indices, indices_valid)
     indices = jnp.asarray(indices)
+    if col.size == 0:
+        n_out = indices.shape[0]
+        return Column.string(jnp.zeros((0,), jnp.uint8),
+                             jnp.zeros((n_out + 1,), jnp.int32),
+                             validity=jnp.zeros((n_out,), jnp.bool_))
     mat, lengths = to_padded_bytes(col)
     n = mat.shape[0]
     ok = (indices >= 0) & (indices < n)

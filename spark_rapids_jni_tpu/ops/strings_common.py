@@ -27,6 +27,15 @@ def pad_width_bucket(max_len: int, minimum: int = 4) -> int:
     return w
 
 
+def string_width_bucket(col) -> int:
+    """The padded-bytes bucket width ``to_padded_bytes`` would pick for a
+    STRING column — the ONE place that rule lives, so join paths that must
+    force a common width across two sides (stringplane explosion) cannot
+    drift from the matrix layout."""
+    lens = np.diff(np.asarray(col.offsets))
+    return pad_width_bucket(int(lens.max()) if lens.size else 0)
+
+
 @functools.partial(jax.jit, static_argnums=2)
 def _gather_matrix(chars: jnp.ndarray, offsets: jnp.ndarray, width: int):
     starts = offsets[:-1]
@@ -43,8 +52,7 @@ def to_padded_bytes(col: Column, width: int | None = None):
         raise TypeError(f"expected STRING column, got {col.dtype!r}")
     offsets = jnp.asarray(col.offsets, jnp.int32)
     if width is None:
-        lens = np.diff(np.asarray(offsets))
-        width = pad_width_bucket(int(lens.max()) if lens.size else 0)
+        width = string_width_bucket(col)
     chars = col.data if col.data is not None and col.data.shape[0] else \
         jnp.zeros((1,), jnp.uint8)
     return _gather_matrix(jnp.asarray(chars, jnp.uint8), offsets, int(width))

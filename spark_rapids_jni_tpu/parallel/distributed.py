@@ -79,8 +79,15 @@ def _padded_table(out_keys, out_aggs, key_names):
     cols, names = [], []
     for spec, nm in zip(out_keys, key_names):
         if spec[0] == "string":
-            raise TypeError("string keys not supported in the distributed "
-                            "path yet (dictionary-encode first)")
+            # internal invariant, not a user-facing limit: the public entry
+            # points (distributed_groupby/distributed_join) explode STRING
+            # columns into fixed-width (len, word...) columns before building
+            # this program (stringplane.explode_strings), so no string spec
+            # can reach the exchange
+            raise AssertionError(
+                "string key reached the distributed exchange unexploded; "
+                "use distributed_groupby/distributed_join (they explode "
+                "strings via stringplane), or explode_strings() first")
         _, dtype, data, valid = spec
         cols.append(Column(dtype, data=data, validity=valid))
         names.append(nm if isinstance(nm, str) else f"key{nm}")
@@ -285,28 +292,55 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
             ltbl, rtbl, list(on_left), list(on_right), jcap,
             left_live=llive, right_live=rlive)
 
-        if how in ("inner", "left"):
-            if how == "left":
-                nl = ndev * lcap
-                matched = jnp.zeros((nl,), jnp.bool_)
-                if jcap:
-                    matched = matched.at[li].max(jlive)
-                extra_live = llive & jnp.logical_not(matched)
-                li = jnp.concatenate(
-                    [li, jnp.arange(nl, dtype=jnp.int32)])
-                ri = jnp.concatenate(
-                    [ri, jnp.zeros((nl,), jnp.int32)])
-                rvalid = jnp.concatenate(
-                    [jlive, jnp.zeros((nl,), jnp.bool_)])
-                live = jnp.concatenate([jlive, extra_live])
-            else:
-                rvalid = jlive
-                live = jlive
+        if how in ("inner", "left", "right", "full"):
+            nl = ndev * lcap
+            nr = ndev * rcap
+            lvalid = jnp.ones(jlive.shape, jnp.bool_)
+            rvalid = jlive
+            live = jlive
+            # matched masks over the ORIGINAL pair arrays, before any
+            # outer-extension concatenation below changes their length
+            matched_l = jnp.zeros((nl,), jnp.bool_)
+            matched_r = jnp.zeros((nr,), jnp.bool_)
+            if jcap:
+                matched_l = matched_l.at[li].max(jlive)
+                matched_r = matched_r.at[ri].max(jlive)
+            if how in ("left", "full"):
+                li = jnp.concatenate([li, jnp.arange(nl, dtype=jnp.int32)])
+                ri = jnp.concatenate([ri, jnp.zeros((nl,), jnp.int32)])
+                lvalid = jnp.concatenate([lvalid, jnp.ones((nl,), jnp.bool_)])
+                rvalid = jnp.concatenate([rvalid, jnp.zeros((nl,), jnp.bool_)])
+                live = jnp.concatenate(
+                    [live, llive & jnp.logical_not(matched_l)])
+            if how in ("right", "full"):
+                li = jnp.concatenate([li, jnp.zeros((nr,), jnp.int32)])
+                ri = jnp.concatenate([ri, jnp.arange(nr, dtype=jnp.int32)])
+                lvalid = jnp.concatenate([lvalid, jnp.zeros((nr,), jnp.bool_)])
+                rvalid = jnp.concatenate([rvalid, jnp.ones((nr,), jnp.bool_)])
+                live = jnp.concatenate(
+                    [live, rlive & jnp.logical_not(matched_r)])
             lsel = tuple(jnp.take(c.data, li, axis=0) for c in ltbl.columns)
-            lselv = tuple(jnp.take(c.valid_mask(), li) for c in ltbl.columns)
+            lselv = tuple(jnp.take(c.valid_mask(), li) & lvalid
+                          for c in ltbl.columns)
             rsel = tuple(jnp.take(c.data, ri, axis=0) for c in rtbl.columns)
             rselv = tuple(jnp.take(c.valid_mask(), ri) & rvalid
                           for c in rtbl.columns)
+            if how in ("right", "full"):
+                # coalesce key columns shard-side: rows missing on the left
+                # (right-extra rows) take the right side's key value, so the
+                # host wrapper's drop-right-keys projection stays correct
+                lsel, lselv = list(lsel), list(lselv)
+                for lk_name, rk_name in zip(on_left, on_right):
+                    i = list(lnames).index(lk_name)
+                    j = list(rnames).index(rk_name)
+                    rkey = jnp.take(rtbl.columns[j].data, ri, axis=0)
+                    lmask = lvalid.reshape(
+                        lvalid.shape + (1,) * (rkey.ndim - 1))
+                    lsel[i] = jnp.where(lmask, lsel[i], rkey)
+                    lselv[i] = jnp.where(
+                        lvalid, lselv[i],
+                        jnp.take(rtbl.columns[j].valid_mask(), ri) & rvalid)
+                lsel, lselv = tuple(lsel), tuple(lselv)
             nrows = jnp.sum(live.astype(jnp.int32))
             return (lsel, lselv, rsel, rselv, live, jnp.reshape(nrows, (1,)),
                     jax.lax.psum(lovf + rovf, axis),
@@ -338,25 +372,43 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
                      capacity: int | None = None,
                      join_capacity: int | None = None,
                      suffixes=("", "_r"), axis: str = ROW_AXIS) -> Table:
-    """Distributed equi-join (inner/left/semi/anti); compacts to a host Table.
+    """Distributed equi-join (inner/left/right/full/semi/anti); compacts to a
+    host Table.
 
     Both sides are hash-partitioned on the join keys over the mesh, then
     joined shard-locally — the 8-chip shuffle + SortMergeJoin plan of
-    BASELINE configs[3].  STRING columns travel in padded-bucket form.
-    ``capacity`` bounds rows received per (source, dest) pair per side;
-    ``join_capacity`` bounds candidate pairs per shard.  Overflow raises
-    with the counts, never silently drops.
+    BASELINE configs[3].  Outer rows (left/right/full) are shard-local
+    correct because co-partitioning puts every occurrence of a key on one
+    shard.  STRING columns travel in padded-bucket form; string JOIN KEYS
+    are exploded at one common bucket width across both sides (the word
+    count is part of the key identity — different widths would partition
+    the same string to different shards).  ``capacity`` bounds rows
+    received per (source, dest) pair per side; ``join_capacity`` bounds
+    candidate pairs per shard.  Overflow raises with the counts, never
+    silently drops.
     """
     from .mesh import pad_to_multiple, shard_table
     from .stringplane import explode_strings, reassemble_strings
+    from ..ops.strings_common import string_width_bucket
     on_right = list(on_right or on_left)
     on_left = list(on_left)
     ndev = mesh.shape[axis]
 
-    def prep(t, keys):
+    def _key_width(t, k):
+        c = t.column(k)
+        return string_width_bucket(c) if c.dtype.is_string else None
+
+    lov, rov = {}, {}
+    for lk, rk in zip(on_left, on_right):
+        wl, wr = _key_width(left, lk), _key_width(right, rk)
+        if wl is not None or wr is not None:
+            w = max(wl or 0, wr or 0)
+            lov[lk], rov[rk] = w, w
+
+    def prep(t, keys, overrides):
         plan = None
         if any(c.dtype.is_string for c in t.columns):
-            t, plan = explode_strings(t)
+            t, plan = explode_strings(t, width_overrides=overrides)
             keys = plan.exploded_keys(keys)
         if t.num_rows % ndev:
             t, _ = pad_to_multiple(t, ndev)
@@ -364,8 +416,12 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
         t = shard_table(t, mesh, axis)
         return t, keys, plan
 
-    lt, lkeys, lplan = prep(left, on_left)
-    rt, rkeys, rplan = prep(right, on_right)
+    lt, lkeys, lplan = prep(left, on_left, lov)
+    rt, rkeys, rplan = prep(right, on_right, rov)
+    if len(lkeys) != len(rkeys):
+        raise TypeError(
+            f"join key shapes disagree after explosion: {lkeys} vs {rkeys} "
+            "(string keys must pair with string keys)")
     auto_cap = capacity is None
     auto_jcap = join_capacity is None
     if auto_cap:
@@ -445,6 +501,90 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
         nm = rtab.names[i]
         out_cols.append(rtab.columns[i])
         out_names.append(nm + (suffixes[1] if nm in lout_names else ""))
+    return Table(out_cols, out_names)
+
+
+@functools.lru_cache(maxsize=8)
+def build_distributed_cross(mesh: Mesh, axis: str = ROW_AXIS):
+    """Compile-once distributed cross join: left row-sharded, right
+    replicated (the Spark BroadcastNestedLoopJoin/CartesianProduct plan
+    shape — no exchange at all; each shard pairs its left rows with the
+    full right side)."""
+    def shard_fn(ldatas, lmasks, llive, rdatas, rmasks):
+        nl = ldatas[0].shape[0]
+        nr = rdatas[0].shape[0]
+        li = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), nr)
+        ri = jnp.tile(jnp.arange(nr, dtype=jnp.int32), nl)
+        def sel(datas, masks, idx):
+            d = tuple(jnp.take(x, idx, axis=0) for x in datas)
+            v = tuple(jnp.ones(idx.shape, jnp.bool_) if m is None
+                      else jnp.take(m, idx) for m in masks)
+            return d, v
+        lsel, lselv = sel(ldatas, lmasks, li)
+        rsel, rselv = sel(rdatas, rmasks, ri)
+        live = jnp.take(llive, li)
+        return lsel, lselv, rsel, rselv, live
+    spec = P(axis)
+    return jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec, spec, spec, P(), P()),
+        out_specs=(spec, spec, spec, spec, spec), check_vma=False))
+
+
+@traced("distributed_cross_join")
+def distributed_cross_join(left: Table, right: Table, mesh: Mesh,
+                           suffixes=("", "_r"), axis: str = ROW_AXIS) -> Table:
+    """Distributed Cartesian product; compacts to a host Table.
+
+    Left is row-sharded over the mesh, right is replicated to every shard
+    (no collective traffic).  Output row order is shard-major and otherwise
+    unspecified, as in Spark."""
+    from .mesh import pad_to_multiple, shard_table
+    from .stringplane import explode_strings, reassemble_strings
+    ndev = mesh.shape[axis]
+    lt, lplan = (explode_strings(left)
+                 if any(c.dtype.is_string for c in left.columns)
+                 else (left, None))
+    rt, rplan = (explode_strings(right)
+                 if any(c.dtype.is_string for c in right.columns)
+                 else (right, None))
+    n_orig = lt.num_rows
+    if lt.num_rows % ndev:
+        lt, n_orig = pad_to_multiple(lt, ndev)
+    llive = jnp.arange(lt.num_rows, dtype=jnp.int64) < n_orig
+    lt = shard_table(lt, mesh, axis)
+    llive = jax.device_put(
+        llive, jax.sharding.NamedSharding(mesh, P(axis)))
+    fn = build_distributed_cross(mesh, axis)
+    lsel, lselv, rsel, rselv, live = fn(
+        tuple(c.data for c in lt.columns),
+        tuple(c.validity for c in lt.columns), llive,
+        tuple(c.data for c in rt.columns),
+        tuple(c.validity for c in rt.columns))
+    live_np = np.asarray(live)
+
+    def compact(specs, valids, schema, names):
+        cols = []
+        for dt_, d, v in zip(schema, specs, valids):
+            dn = np.asarray(d)[live_np]
+            vn = np.asarray(v)[live_np]
+            cols.append(Column(dt_, data=jnp.asarray(dn),
+                               validity=None if vn.all() else jnp.asarray(vn)))
+        return Table(cols, list(names))
+
+    lnames = list(lt.names or [f"l{i}" for i in range(lt.num_columns)])
+    rnames = list(rt.names or [f"r{i}" for i in range(rt.num_columns)])
+    ltab = compact(lsel, lselv, lt.dtypes(), lnames)
+    rtab = compact(rsel, rselv, rt.dtypes(), rnames)
+    if lplan is not None:
+        ltab = reassemble_strings(ltab, lplan)
+    if rplan is not None:
+        rtab = reassemble_strings(rtab, rplan)
+    out_cols = list(ltab.columns)
+    out_names = list(ltab.names)
+    for nm, c in zip(rtab.names, rtab.columns):
+        out_cols.append(c)
+        out_names.append(nm + (suffixes[1] if nm in ltab.names else ""))
     return Table(out_cols, out_names)
 
 

@@ -74,11 +74,17 @@ class StringPlan:
         return out
 
 
-def explode_strings(table: Table) -> tuple[Table, StringPlan]:
+def explode_strings(table: Table, width_overrides: dict | None = None
+                    ) -> tuple[Table, StringPlan]:
     """Replace every STRING column with its fixed-width padded-bucket form.
 
     Host-boundary op (the bucket width is a global data-dependent static);
     everything downstream of it is jit-able.
+
+    ``width_overrides`` maps column name -> minimum byte width.  Join paths
+    use it to force BOTH sides of a join key to one bucket width: the word
+    count is part of the multi-key identity, so sides exploded at different
+    widths would hash (and partition) the same string differently.
     """
     names = tuple(table.names or [f"c{i}" for i in range(table.num_columns)])
     cols, out_names, specs = [], [], []
@@ -88,7 +94,8 @@ def explode_strings(table: Table) -> tuple[Table, StringPlan]:
             out_names.append(nm)
             specs.append(("fixed",))
             continue
-        mat, lengths = to_padded_bytes(c)
+        mat, lengths = to_padded_bytes(
+            c, width=(width_overrides or {}).get(nm))
         n, w = mat.shape
         nwords = max((w + 3) // 4, 1)
         if w < nwords * 4:

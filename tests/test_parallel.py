@@ -437,3 +437,63 @@ def test_distributed_groupby_var_std(mesh):
     for kk in o.index:
         assert abs(d[kk][0] - o.loc[kk, "var"]) < 1e-9
         assert abs(d[kk][1] - o.loc[kk, "std"]) < 1e-9
+
+
+def test_distributed_join_right_matches_local(mesh):
+    from spark_rapids_jni_tpu.ops.join import right_join
+    left, right = _join_fixture(seed=51)
+    got = distributed_join(left, right, mesh, ["k"], how="right")
+    want = right_join(left, right, ["k"])
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
+
+
+def test_distributed_join_full_matches_local(mesh):
+    from spark_rapids_jni_tpu.ops.join import full_join
+    left, right = _join_fixture(seed=52)
+    got = distributed_join(left, right, mesh, ["k"], how="full")
+    want = full_join(left, right, ["k"])
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
+
+
+def test_distributed_join_string_keys_mismatched_widths(mesh):
+    """Regression: the two sides' key strings bucket to different padded
+    widths (8 vs 4); without a common explode width the same key would
+    hash-partition to different shards and matches would silently vanish."""
+    from spark_rapids_jni_tpu.ops.join import inner_join, full_join
+    nl, nr = NDEV * 6, NDEV * 4
+    lwords = ["a", "bb", "ccc", "longword"]       # max 8 -> bucket 8
+    rwords = ["a", "bb", "ccc", "dd"]             # max 3 -> bucket 4
+    rng = np.random.default_rng(77)
+    left = Table([
+        Column.from_pylist([lwords[i] for i in rng.integers(0, 4, nl)]),
+        Column.from_numpy(np.arange(nl, dtype=np.int64))], ["s", "lv"])
+    right = Table([
+        Column.from_pylist([rwords[i] for i in rng.integers(0, 4, nr)]),
+        Column.from_numpy(np.arange(nr, dtype=np.int64) * 3)], ["s", "rv"])
+    got = distributed_join(left, right, mesh, ["s"])
+    want = inner_join(left, right, ["s"])
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)
+    gotf = distributed_join(left, right, mesh, ["s"], how="full")
+    wantf = full_join(left, right, ["s"])
+    gotf_r = Table([gotf[nm] for nm in wantf.names], list(wantf.names))
+    assert _rows_set(gotf_r) == _rows_set(wantf)
+
+
+def test_distributed_cross_join(mesh):
+    from spark_rapids_jni_tpu.ops.join import cross_join
+    from spark_rapids_jni_tpu.parallel import distributed_cross_join
+    nl, nr = NDEV * 3 + 5, 7   # left not mesh-divisible (pads + masks)
+    left = Table([
+        Column.from_numpy(np.arange(nl, dtype=np.int64)),
+        Column.from_pylist([f"s{i % 4}" if i % 5 else None
+                            for i in range(nl)])], ["a", "s"])
+    right = Table([
+        Column.from_numpy(np.arange(nr, dtype=np.int64) * 10)], ["b"])
+    got = distributed_cross_join(left, right, mesh)
+    want = cross_join(left, right)
+    assert got.num_rows == nl * nr
+    got_r = Table([got[nm] for nm in want.names], list(want.names))
+    assert _rows_set(got_r) == _rows_set(want)

@@ -470,3 +470,141 @@ def test_groupby_var_nan_payload_under_null():
               ["k", "v"])
     g = groupby(t, ["k"], [("v", "var")], names=["var"])
     assert abs(g["var"].to_pylist()[0] - 0.5) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# right / full-outer / cross joins vs the pandas oracle (VERDICT r3 #5)
+
+
+def _join_oracle(ldict, rdict, on, how):
+    import pandas as pd
+    lf = pd.DataFrame(ldict).astype("object")
+    rf = pd.DataFrame(rdict).astype("object")
+    out = pd.merge(lf, rf, on=on, how=how)
+    return sorted(map(tuple, out.where(out.notna(), None).values.tolist()),
+                  key=lambda r: tuple((v is None, v) for v in r))
+
+
+def _rows(tbl):
+    cols = [c.to_pylist() for c in tbl.columns]
+    return sorted(zip(*cols),
+                  key=lambda r: tuple((v is None, v) for v in r))
+
+
+def test_right_join_matches_pandas():
+    from spark_rapids_jni_tpu.ops import right_join
+    ldict = {"k": [1, 2, 3, 4], "lv": [10, 20, 30, 40]}
+    rdict = {"k": [2, 4, 4, 5, 7], "rv": [200, 400, 401, 500, 700]}
+    left, right = Table.from_pydict(ldict), Table.from_pydict(rdict)
+    out = right_join(left, right, ["k"])
+    assert list(out.names) == ["k", "lv", "rv"]
+    assert _rows(out) == _join_oracle(ldict, rdict, ["k"], "right")
+
+
+def test_full_join_matches_pandas():
+    from spark_rapids_jni_tpu.ops import full_join
+    ldict = {"k": [1, 2, 2, 3], "lv": [10, 20, 21, 30]}
+    rdict = {"k": [2, 4, 5], "rv": [200, 400, 500]}
+    left, right = Table.from_pydict(ldict), Table.from_pydict(rdict)
+    out = full_join(left, right, ["k"])
+    assert _rows(out) == _join_oracle(ldict, rdict, ["k"], "outer")
+
+
+def test_right_full_join_null_keys_never_match():
+    """SQL equi-join: null keys match nothing but outer rows survive."""
+    from spark_rapids_jni_tpu.ops import full_join, right_join
+    left = Table([Column.from_numpy(np.array([1, 2, 3], np.int64),
+                                    validity=np.array([True, False, True])),
+                  Column.from_numpy(np.array([10, 20, 30], np.int64))],
+                 ["k", "lv"])
+    right = Table([Column.from_numpy(np.array([2, 3, 4], np.int64),
+                                     validity=np.array([False, True, True])),
+                   Column.from_numpy(np.array([200, 300, 400], np.int64))],
+                  ["k", "rv"])
+    out = full_join(left, right, ["k"])
+    # matches: only (3, 30, 300); everything else outer with nulls
+    assert out.num_rows == 5
+    assert _rows(out) == sorted(
+        [(3, 30, 300), (1, 10, None), (None, 20, None),
+         (None, None, 200), (4, None, 400)],
+        key=lambda r: tuple((v is None, v) for v in r))
+    rout = right_join(left, right, ["k"])
+    assert _rows(rout) == sorted(
+        [(3, 30, 300), (None, None, 200), (4, None, 400)],
+        key=lambda r: tuple((v is None, v) for v in r))
+
+
+def test_full_join_float_keys_nan_normalized():
+    """Spark join-key float normalization: NaN matches NaN, -0.0 == 0.0."""
+    from spark_rapids_jni_tpu.ops import full_join
+    nan = float("nan")
+    left = Table.from_pydict({"k": [nan, -0.0, 1.5], "lv": [1, 2, 3]})
+    right = Table.from_pydict({"k": [nan, 0.0, 2.5], "rv": [10, 20, 30]})
+    out = full_join(left, right, ["k"])
+    got = {(l, r) for l, r in zip(out["lv"].to_pylist(),
+                                  out["rv"].to_pylist())}
+    assert got == {(1, 10), (2, 20), (3, None), (None, 30)}
+
+
+def test_right_join_string_keys():
+    from spark_rapids_jni_tpu.ops import right_join
+    left = Table.from_pydict({"k": ["a", "bb", "ccc"], "lv": [1, 2, 3]})
+    right = Table.from_pydict({"k": ["bb", "dddd"], "rv": [20, 40]})
+    out = right_join(left, right, ["k"])
+    assert _rows(out) == sorted(
+        [("bb", 2, 20), ("dddd", None, 40)],
+        key=lambda r: tuple((v is None, v) for v in r))
+
+
+def test_cross_join():
+    from spark_rapids_jni_tpu.ops import cross_join
+    ldict = {"a": [1, 2], "b": [10, 20]}
+    rdict = {"c": [5, 6, 7]}
+    out = cross_join(Table.from_pydict(ldict), Table.from_pydict(rdict))
+    assert out.num_rows == 6
+    assert _rows(out) == _join_oracle(ldict, rdict, None, "cross")
+
+
+def test_cross_join_name_collision_suffix():
+    from spark_rapids_jni_tpu.ops import cross_join
+    out = cross_join(Table.from_pydict({"x": [1, 2]}),
+                     Table.from_pydict({"x": [5, 6]}))
+    assert list(out.names) == ["x", "x_r"]
+    assert _rows(out) == [(1, 5), (1, 6), (2, 5), (2, 6)]
+
+
+def test_right_full_join_random_matches_pandas():
+    rng = np.random.default_rng(11)
+    from spark_rapids_jni_tpu.ops import full_join, right_join
+    lk = rng.integers(0, 30, 200)
+    rk = rng.integers(0, 30, 150)
+    ldict = {"k": lk.tolist(), "lv": list(range(200))}
+    rdict = {"k": rk.tolist(), "rv": list(range(150))}
+    left, right = Table.from_pydict(ldict), Table.from_pydict(rdict)
+    assert _rows(right_join(left, right, ["k"])) == \
+        _join_oracle(ldict, rdict, ["k"], "right")
+    assert _rows(full_join(left, right, ["k"])) == \
+        _join_oracle(ldict, rdict, ["k"], "outer")
+
+
+def test_outer_joins_with_empty_side():
+    """Empty partitions are routine in Spark; outer rows must survive."""
+    from spark_rapids_jni_tpu.ops import full_join, left_join, right_join
+    empty = Table.from_pydict({"k": [], "lv": []})
+    right = Table.from_pydict({"k": [1, 2], "rv": [10, 20]})
+    out = right_join(empty, right, ["k"])
+    assert _rows(out) == [(1, None, 10), (2, None, 20)]
+    out = full_join(empty, right, ["k"])
+    assert _rows(out) == [(1, None, 10), (2, None, 20)]
+    out = full_join(right.rename(["k", "lv"]) if hasattr(right, "rename")
+                    else Table(list(right.columns), ["k", "lv"]),
+                    Table.from_pydict({"k": [], "rv": []}), ["k"])
+    assert _rows(out) == [(1, 10, None), (2, 20, None)]
+    out = left_join(empty, right, ["k"])
+    assert out.num_rows == 0
+    # empty string-keyed side (explicitly typed, as a real plan would)
+    es = Table([Column.string(np.zeros(0, np.uint8), np.zeros(1, np.int32)),
+                Column.from_numpy(np.zeros(0, np.int64))], ["k", "lv"])
+    rs = Table.from_pydict({"k": ["a", "b"], "rv": [1, 2]})
+    out = right_join(es, rs, ["k"])
+    assert _rows(out) == [("a", None, 1), ("b", None, 2)]
