@@ -394,6 +394,8 @@ def _map_dtype(t: _OrcType) -> dt.DType:
         return dt.DType(dt.TypeId.LIST)
     if t.kind == TK_LIST:
         return dt.DType(dt.TypeId.LIST)
+    if t.kind == TK_STRUCT:
+        return dt.DType(dt.TypeId.STRUCT)
     raise NotImplementedError(f"unsupported ORC type kind {t.kind}")
 
 
@@ -651,6 +653,21 @@ class ORCFile:
             child = self._decode_column(t.subtypes[0], bufs, encodings,
                                         int(offsets[-1]))
             return Column.list_(child, offsets.astype(np.int32), valid)
+        if k == TK_STRUCT:
+            # ORC struct fields carry one entry per PRESENT struct row;
+            # decode each field over nvals rows, then scatter back to the
+            # n-row frame (null struct rows -> null field rows)
+            kids = [self._decode_column(sub, bufs, encodings, nvals)
+                    for sub in t.subtypes]
+            if valid is not None:
+                from ..ops.selection import gather_column
+                idx = np.full(n, -1, np.int32)
+                idx[valid] = np.arange(nvals, dtype=np.int32)
+                kids = [gather_column(c, jnp.asarray(idx)) for c in kids]
+            return Column(dt.DType(dt.TypeId.STRUCT),
+                          validity=None if valid is None
+                          else jnp.asarray(valid),
+                          children=tuple(kids))
         raise NotImplementedError(f"unsupported ORC type kind {k}")
 
     def _empty_column(self, cid: int) -> Column:
@@ -665,6 +682,9 @@ class ORCFile:
             return Column.list_(child, np.zeros(1, np.int32))
         if odt.id == dt.TypeId.DECIMAL128:
             return Column.fixed(odt, np.zeros((0, 2), np.int64))
+        if odt.id == dt.TypeId.STRUCT:
+            return Column(odt, children=tuple(self._empty_column(s)
+                                              for s in t.subtypes))
         return Column.fixed(odt, np.zeros(0, odt.storage))
 
     def read_stripe(self, i: int, columns=None) -> Table:

@@ -20,8 +20,8 @@ from ..columnar import Table
 from ..ops.selection import gather_column
 from .orc import (COMP_NONE, COMP_SNAPPY, COMP_ZLIB, SK_DATA, SK_LENGTH, SK_PRESENT,
                   SK_SECONDARY, TK_BOOLEAN, TK_BYTE, TK_DATE, TK_DECIMAL,
-                  TK_DOUBLE, TK_FLOAT, TK_INT, TK_LONG, TK_SHORT, TK_STRING,
-                  TK_STRUCT, TK_TIMESTAMP, _ORC_EPOCH_S)
+                  TK_DOUBLE, TK_FLOAT, TK_INT, TK_LIST, TK_LONG, TK_SHORT,
+                  TK_STRING, TK_STRUCT, TK_TIMESTAMP, _ORC_EPOCH_S)
 from .thrift import _enc_varint  # one LEB128 encoder for the whole io package
 
 _MAGIC = b"ORC"
@@ -204,6 +204,98 @@ def _encode_nanos(nanos) -> list:
     return out
 
 
+def _subtree_size(col) -> int:
+    """Number of ORC column ids this column's type subtree occupies."""
+    if col.dtype.id == dt.TypeId.LIST:
+        return 1 + _subtree_size(col.children[0])
+    if col.dtype.id == dt.TypeId.STRUCT:
+        return 1 + sum(_subtree_size(c) for c in col.children)
+    return 1
+
+
+def _append_types(types: bytearray, col, next_id: int,
+                  field_names=None) -> int:
+    """Pre-order Type messages for one column's subtree (matches the id
+    assignment `_emit_streams` uses); ``next_id`` is this column's id,
+    returns the next free id."""
+    d = col.dtype
+    tmsg = bytearray()
+    if d.id == dt.TypeId.LIST:
+        _pb_varint(tmsg, 1, TK_LIST)
+        _pb_varint(tmsg, 2, next_id + 1)  # element is the next pre-order id
+        _pb_bytes(types, 4, bytes(tmsg))
+        return _append_types(types, col.children[0], next_id + 1)
+    if d.id == dt.TypeId.STRUCT:
+        _pb_varint(tmsg, 1, TK_STRUCT)
+        fid = next_id + 1
+        for c in col.children:
+            _pb_varint(tmsg, 2, fid)
+            fid += _subtree_size(c)
+        names = field_names or [f"f{i}" for i in range(len(col.children))]
+        for nm in names:
+            _pb_bytes(tmsg, 3, nm.encode())
+        _pb_bytes(types, 4, bytes(tmsg))
+        nid = next_id + 1
+        for c in col.children:
+            nid = _append_types(types, c, nid)
+        return nid
+    kind, extra = _orc_type(d)
+    _pb_varint(tmsg, 1, kind)
+    if "precision" in extra:
+        _pb_varint(tmsg, 5, extra["precision"])
+        _pb_varint(tmsg, 6, extra["scale"])
+    _pb_bytes(types, 4, bytes(tmsg))
+    return next_id + 1
+
+
+def _emit_streams(col, cid: int, out: list) -> int:
+    """Append (cid, stream_kind, raw) entries for this column subtree in
+    pre-order id order; returns the next free column id.
+
+    ORC nesting contract (mirrored from the reader,
+    io/orc.py _decode_column TK_LIST/TK_STRUCT): a LIST's LENGTH stream and
+    a STRUCT's children carry entries only for PRESENT parent rows, and a
+    LIST's element column covers the concatenated elements of present rows.
+    """
+    d = col.dtype
+    valid = None
+    if col.validity is not None:
+        v = np.asarray(col.validity)
+        if not v.all():
+            valid = v
+    if d.id == dt.TypeId.LIST:
+        if valid is not None:
+            out.append((cid, SK_PRESENT, _bool_rle(valid)))
+        offs = np.asarray(col.offsets, np.int64)
+        lens = np.diff(offs)
+        child = col.children[0]
+        if valid is not None:
+            # elements of non-present rows must not reach the child column;
+            # vectorized repeat/cumsum index (same pattern as strings.split)
+            lens = lens[valid]
+            starts = offs[:-1][valid]
+            total = int(lens.sum())
+            pos = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=pos[1:])
+            el_idx = (np.repeat(starts, lens) + np.arange(total)
+                      - np.repeat(pos[:-1], lens)).astype(np.int32)
+            child = gather_column(child, el_idx)
+        out.append((cid, SK_LENGTH, _int_rle_v1(lens, signed=False)))
+        return _emit_streams(child, cid + 1, out)
+    if d.id == dt.TypeId.STRUCT:
+        if valid is not None:
+            out.append((cid, SK_PRESENT, _bool_rle(valid)))
+        nid = cid + 1
+        for c in col.children:
+            if valid is not None:
+                c = gather_column(c, np.flatnonzero(valid).astype(np.int32))
+            nid = _emit_streams(c, nid, out)
+        return nid
+    for kind, raw in _column_streams(col, d):
+        out.append((cid, kind, raw))
+    return cid + 1
+
+
 def _column_streams(col, dtype: dt.DType) -> list[tuple[int, bytes]]:
     """-> [(stream_kind, raw bytes)] for one column over one stripe."""
     streams = []
@@ -312,8 +404,14 @@ def _compress_stream(raw: bytes, kind: int, block: int) -> bytes:
 
 
 def write_orc(table: Table, path, compression: str = "none",
-              stripe_rows: int = 1 << 20):
-    """Write a Table as an ORC 0.12 file readable by any ORC reader."""
+              stripe_rows: int = 1 << 20,
+              struct_fields: dict | None = None):
+    """Write a Table as an ORC 0.12 file readable by any ORC reader.
+
+    LIST and STRUCT columns write the standard nested ORC encoding
+    (pre-order column ids, LENGTH streams and present-row-filtered
+    children).  ``struct_fields`` maps a STRUCT column name to its field
+    names (children are unnamed in the engine's Column; default f0, f1...)."""
     kinds = {"none": COMP_NONE, "uncompressed": COMP_NONE,
              "zlib": COMP_ZLIB}
     if _SNAPPY_C is not None:
@@ -324,25 +422,26 @@ def write_orc(table: Table, path, compression: str = "none",
         table.names or [f"c{i}" for i in range(table.num_columns)])]
     n = table.num_rows
 
-    # types: struct root (id 0) + one child per column
+    # types: struct root (id 0) + pre-order subtree per column (LIST and
+    # STRUCT columns occupy one id per nested node, like ORC-C++)
     types = bytearray()
     root = bytearray()
     _pb_varint(root, 1, TK_STRUCT)
-    for i in range(table.num_columns):
-        _pb_varint(root, 2, i + 1)
+    cid = 1
+    top_ids = []
+    for c in table.columns:
+        top_ids.append(cid)
+        cid += _subtree_size(c)
+    total_ids = cid  # including root
+    for i in top_ids:
+        _pb_varint(root, 2, i)
     for nm in names:
         _pb_bytes(root, 3, nm.encode())
     _pb_bytes(types, 4, bytes(root))  # footer field 4 = repeated Type
-    col_extras = []
-    for c in table.columns:
-        kind, extra = _orc_type(c.dtype)
-        tmsg = bytearray()
-        _pb_varint(tmsg, 1, kind)
-        if "precision" in extra:
-            _pb_varint(tmsg, 5, extra["precision"])
-            _pb_varint(tmsg, 6, extra["scale"])
-        _pb_bytes(types, 4, bytes(tmsg))
-        col_extras.append(extra)
+    nid = 1
+    for c, nm in zip(table.columns, names):
+        nid = _append_types(types, c, nid,
+                            (struct_fields or {}).get(nm))
 
     body = bytearray()
     body += _MAGIC  # header
@@ -355,16 +454,18 @@ def write_orc(table: Table, path, compression: str = "none",
         offset = len(body)
         sfooter = bytearray()
         data_blobs = []
-        for ci, c in enumerate(sliced):
-            for kind, raw in _column_streams(c, c.dtype):
-                blob = _compress_stream(raw, comp, block)
-                smsg = bytearray()
-                _pb_varint(smsg, 1, kind)
-                _pb_varint(smsg, 2, ci + 1)
-                _pb_varint(smsg, 3, len(blob))
-                _pb_bytes(sfooter, 1, bytes(smsg))
-                data_blobs.append(blob)
-        for _ in range(table.num_columns + 1):  # encodings: DIRECT for all
+        entries = []
+        for c, top_id in zip(sliced, top_ids):
+            _emit_streams(c, top_id, entries)
+        for scid, kind, raw in entries:
+            blob = _compress_stream(raw, comp, block)
+            smsg = bytearray()
+            _pb_varint(smsg, 1, kind)
+            _pb_varint(smsg, 2, scid)
+            _pb_varint(smsg, 3, len(blob))
+            _pb_bytes(sfooter, 1, bytes(smsg))
+            data_blobs.append(blob)
+        for _ in range(total_ids):  # encodings: DIRECT for every id
             emsg = bytearray()
             _pb_varint(emsg, 1, 0)
             _pb_bytes(sfooter, 2, bytes(emsg))

@@ -53,11 +53,19 @@ from .parquet import _SNAPPY_NATIVE as _SNAPPY  # one codec handle for io/
 
 def _rle_bitpacked_bools(bits: np.ndarray) -> bytes:
     """Definition levels (bit width 1) as one bit-packed hybrid run."""
-    n = len(bits)
+    return _rle_levels(bits.astype(np.uint8), 1)
+
+
+def _rle_levels(levels: np.ndarray, bit_width: int) -> bytes:
+    """Level stream at ``bit_width`` bits as one bit-packed hybrid run
+    (LSB-first within each value, groups of 8 values)."""
+    n = len(levels)
     groups = (n + 7) // 8
     padded = np.zeros(groups * 8, np.uint8)
-    padded[:n] = bits.astype(np.uint8)
-    packed = np.packbits(padded, bitorder="little").tobytes()
+    padded[:n] = levels.astype(np.uint8)
+    # (8*groups, bit_width) LSB-first bit matrix -> packbits little
+    bits = (padded[:, None] >> np.arange(bit_width, dtype=np.uint8)) & 1
+    packed = np.packbits(bits.reshape(-1), bitorder="little").tobytes()
     header = bytearray()
     _enc_varint(header, (groups << 1) | 1)
     return bytes(header) + packed
@@ -115,46 +123,101 @@ def _stats(col, dtype: dt.DType, valid):
     return lo.tobytes(), hi.tobytes(), nulls
 
 
-def _schema_elements(table: Table, names, nullable) -> list:
+def _leaf_element(col, name, nl) -> list:
+    if col.dtype.id not in _PHYS:
+        raise NotImplementedError(
+            f"parquet write for {col.dtype!r} is not supported")
+    phys, conv, _ = _PHYS[col.dtype.id]
+    fields = [(1, T_I32, phys),
+              (3, T_I32, 1 if nl else 0),
+              (4, T_BINARY, name)]
+    if conv is not None:
+        fields.append((6, T_I32, conv))
+    if col.dtype.is_decimal:
+        # engine scale is the power-of-ten exponent (cudf convention);
+        # parquet scale counts digits right of the point
+        fields.append((7, T_I32, -col.dtype.scale))
+        fields.append((8, T_I32, 9 if col.dtype.id == dt.TypeId.DECIMAL32
+                       else 18))
+    return fields
+
+
+def _field_names(struct_fields, name, col):
+    fns = (struct_fields or {}).get(name)
+    if fns is None:
+        return [f"f{fi}" for fi in range(len(col.children))]
+    if len(fns) != len(col.children):
+        raise ValueError(f"struct_fields[{name!r}] has {len(fns)} names "
+                         f"for {len(col.children)} fields")
+    return list(fns)
+
+
+def _schema_elements(table: Table, names, nullable, struct_fields) -> list:
     root = [(4, T_BINARY, "schema"), (5, T_I32, table.num_columns)]
     elements = [root]
     for col, name, nl in zip(table.columns, names, nullable):
-        if col.dtype.id not in _PHYS:
-            raise NotImplementedError(
-                f"parquet write for {col.dtype!r} is not supported")
-        phys, conv, _ = _PHYS[col.dtype.id]
-        fields = [(1, T_I32, phys),
-                  (3, T_I32, 1 if nl else 0),
-                  (4, T_BINARY, name)]
-        if conv is not None:
-            fields.append((6, T_I32, conv))
-        if col.dtype.is_decimal:
-            # engine scale is the power-of-ten exponent (cudf convention);
-            # parquet scale counts digits right of the point
-            fields.append((7, T_I32, -col.dtype.scale))
-            fields.append((8, T_I32, 9 if col.dtype.id == dt.TypeId.DECIMAL32
-                           else 18))
-        elements.append(fields)
+        if col.dtype.id == dt.TypeId.STRUCT:
+            elements.append([(3, T_I32, 1 if nl else 0),
+                             (4, T_BINARY, name),
+                             (5, T_I32, len(col.children))])
+            fns = _field_names(struct_fields, name, col)
+            for fi, child in enumerate(col.children):
+                elements.append(_leaf_element(
+                    child, fns[fi], child.validity is not None))
+            continue
+        elements.append(_leaf_element(col, name, nl))
     return elements
 
 
 def write_parquet(table: Table, path, compression: str = "snappy",
-                  row_group_size: int = 1 << 20) -> None:
-    """Write a Table to ``path`` as a standard parquet file."""
+                  row_group_size: int = 1 << 20,
+                  struct_fields: dict | None = None) -> None:
+    """Write a Table to ``path`` as a standard parquet file.
+
+    ``struct_fields`` maps a STRUCT column name to its field-name list —
+    the engine's Column carries unnamed children (the DType system mirrors
+    the reference's (typeId, scale) pair, RowConversion.java:113-118), so
+    without it struct fields are written as f0, f1, ...  A read-modify-
+    write round trip can preserve names via
+    ``ParquetFile(path).schema[i].fields``."""
     names = list(table.names or
                  [f"c{i}" for i in range(table.num_columns)])
     codec_id = 0
     codec = None
     if compression == "snappy" and _SNAPPY is not None:
         codec_id, codec = 1, _SNAPPY
+    elif compression == "gzip":
+        import gzip as _gzip
+
+        class _Gz:
+            @staticmethod
+            def compress(b, asbytes=True):
+                return _gzip.compress(b, 6)
+        codec_id, codec = 2, _Gz
+    elif compression == "zstd":
+        import pyarrow as _pa
+
+        class _Zs:
+            _c = _pa.Codec("zstd")
+
+            @classmethod
+            def compress(cls, b, asbytes=True):
+                return cls._c.compress(b, asbytes=True)
+        codec_id, codec = 6, _Zs
     elif compression not in (None, "none", "snappy"):
-        raise ValueError(f"unsupported compression {compression!r}")
+        raise ValueError(f"unsupported compression {compression!r} "
+                         "(none, snappy, gzip, zstd)")
 
     from ..ops.selection import slice_table
     # nullability is a schema-level decision made once on the input table;
     # slicing can materialize an all-true validity, which must not flip a
     # REQUIRED column to writing definition levels
     nullable = [c.validity is not None for c in table.columns]
+    field_nullable = {
+        (ci, fi): ch.validity is not None
+        for ci, c in enumerate(table.columns)
+        if c.dtype.id == dt.TypeId.STRUCT
+        for fi, ch in enumerate(c.children)}
     out = bytearray(_MAGIC)
     row_groups = []
     n = table.num_rows
@@ -166,21 +229,53 @@ def write_parquet(table: Table, path, compression: str = "snappy",
         g_rows = stop - start
         chunks = []
         g_bytes = 0
+
+        # flatten to leaf chunks: a plain column is one leaf at path [name];
+        # a STRUCT column is one leaf per field at path [name, f{i}], with
+        # 2-level definition levels when the struct itself is nullable
+        leaves = []  # (col path, leaf_col, max_def, levels, present_mask)
         for ci, (col, name) in enumerate(zip(part.columns, names)):
-            dtype = col.dtype
-            if nullable[ci]:
-                valid = np.ones(g_rows, np.bool_) if col.validity is None \
-                    else np.asarray(col.validity)
+            if col.dtype.id == dt.TypeId.STRUCT:
+                s_opt = nullable[ci]
+                fns = _field_names(struct_fields, name, col)
+                svalid = (np.ones(g_rows, np.bool_) if col.validity is None
+                          else np.asarray(col.validity))
+                for fi, child in enumerate(col.children):
+                    f_opt = field_nullable[(ci, fi)]
+                    md = (1 if s_opt else 0) + (1 if f_opt else 0)
+                    fvalid = (np.asarray(child.validity) if f_opt
+                              else np.ones(g_rows, np.bool_))
+                    present = svalid & fvalid
+                    levels = np.zeros(g_rows, np.uint8)
+                    if s_opt:
+                        levels += svalid
+                    if f_opt:
+                        levels += svalid & fvalid
+                    leaves.append(([name, fns[fi]], child, md,
+                                   levels if md else None,
+                                   present if md else None))
             else:
-                valid = None
+                if nullable[ci]:
+                    valid = (np.ones(g_rows, np.bool_)
+                             if col.validity is None
+                             else np.asarray(col.validity))
+                    leaves.append(([name], col, 1, valid.astype(np.uint8),
+                                   valid))
+                else:
+                    leaves.append(([name], col, 0, None, None))
+
+        for cpath, col, md, levels, present in leaves:
+            dtype = col.dtype
             body = b""
-            if valid is not None:
-                lv = _rle_bitpacked_bools(valid)
+            if md:
+                lv = _rle_levels(levels, md.bit_length())
                 body += len(lv).to_bytes(4, "little") + lv
-            vals, nnon = _plain_values(col, dtype, valid)
+            vals, nnon = _plain_values(
+                col, dtype, None if present is None else present)
             body += vals
             comp = codec.compress(body, asbytes=True) if codec else body
-            smin, smax, nulls = _stats(col, dtype, valid)
+            smin, smax, nulls = _stats(
+                col, dtype, None if present is None else present)
             stats_fields = [(3, T_I64, nulls)]
             if smin is not None:
                 stats_fields += [(5, T_BINARY, smax), (6, T_BINARY, smin)]
@@ -202,7 +297,7 @@ def write_parquet(table: Table, path, compression: str = "snappy",
             meta = [
                 (1, T_I32, phys),
                 (2, T_LIST, (T_I32, [0, 3])),       # PLAIN, RLE
-                (3, T_LIST, (T_BINARY, [name])),
+                (3, T_LIST, (T_BINARY, list(cpath))),
                 (4, T_I32, codec_id),
                 (5, T_I64, g_rows),
                 (6, T_I64, len(header) + len(body)),
@@ -220,7 +315,7 @@ def write_parquet(table: Table, path, compression: str = "snappy",
         if n == 0:
             break
 
-    schema = _schema_elements(table, names, nullable)
+    schema = _schema_elements(table, names, nullable, struct_fields)
     footer = encode_struct([
         (1, T_I32, 1),                              # version
         (2, T_LIST, (T_STRUCT, schema)),

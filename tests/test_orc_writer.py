@@ -140,3 +140,90 @@ def test_pre1970_timestamp_run_rle_base_overflow(tmp_path):
         [v * 10**6 for v in vals]
     # ... and so must the engine's own reader
     assert read_orc(p)["ts"].to_pylist() == [v * 10**6 for v in vals]
+
+
+# ---------------------------------------------------------------------------
+# nested types (VERDICT r3 #6: ORC LIST/STRUCT write)
+
+
+def test_list_int_roundtrip(tmp_path):
+    vals = [[1, 2, 3], [], None, [4], [5, 6]]
+    c = Column.from_pylist(vals)
+    t = Table([c, Column.from_numpy(np.arange(5, dtype=np.int64))],
+              ["l", "k"])
+    p = tmp_path / "l.orc"
+    write_orc(t, p)
+    back = porc.ORCFile(p).read()
+    assert back["l"].to_pylist() == vals
+    assert back["k"].to_pylist() == list(range(5))
+    # engine self-read
+    sb = read_orc(p)
+    assert sb["l"].to_pylist() == vals
+
+
+def test_list_string_roundtrip(tmp_path):
+    vals = [["a", "bb"], None, [], ["ccc", None, "d"]]
+    t = Table([Column.from_pylist(vals)], ["ls"])
+    p = tmp_path / "ls.orc"
+    write_orc(t, p)
+    back = porc.ORCFile(p).read()
+    assert back["ls"].to_pylist() == vals
+    assert read_orc(p)["ls"].to_pylist() == vals
+
+
+def test_struct_roundtrip_with_nulls(tmp_path):
+    from spark_rapids_jni_tpu import dtypes as sdt
+    n = 500
+    rng = np.random.default_rng(31)
+    svalid = rng.random(n) > 0.2
+    fvalid = rng.random(n) > 0.3
+    x = rng.integers(-10**9, 10**9, n)
+    y = rng.standard_normal(n)
+    st = Column(sdt.DType(sdt.TypeId.STRUCT), validity=svalid,
+                children=(Column.from_numpy(x, validity=fvalid),
+                          Column.from_numpy(y)))
+    t = Table([st, Column.from_numpy(np.arange(n, dtype=np.int64))],
+              ["st", "k"])
+    p = tmp_path / "st.orc"
+    write_orc(t, p, struct_fields={"st": ["a", "b"]})
+    back = porc.ORCFile(p).read()
+    got = back["st"].to_pylist()
+    for i in range(n):
+        if not svalid[i]:
+            assert got[i] is None, i
+        else:
+            assert got[i]["a"] == (int(x[i]) if fvalid[i] else None), i
+            assert abs(got[i]["b"] - float(y[i])) < 1e-12, i
+    # engine self-read (reader STRUCT support, r4)
+    sb = read_orc(p)
+    got2 = sb["st"].to_pylist()
+    want = [None if not svalid[i] else
+            ((int(x[i]) if fvalid[i] else None), float(y[i]))
+            for i in range(n)]
+    assert [None if g is None else (g[0], round(g[1], 9)) for g in got2] == \
+        [None if w is None else (w[0], round(w[1], 9)) for w in want]
+
+
+def test_nested_list_of_list_roundtrip(tmp_path):
+    vals = [[[1, 2], [3]], [], None, [[4], [], [5, 6, 7]]]
+    t = Table([Column.from_pylist(vals)], ["ll"])
+    p = tmp_path / "ll.orc"
+    write_orc(t, p, compression="zlib")
+    back = porc.ORCFile(p).read()
+    assert back["ll"].to_pylist() == vals
+    assert read_orc(p)["ll"].to_pylist() == vals
+
+
+def test_struct_multistripe_compressed(tmp_path):
+    from spark_rapids_jni_tpu import dtypes as sdt
+    n = 3_000
+    rng = np.random.default_rng(33)
+    st = Column(sdt.DType(sdt.TypeId.STRUCT),
+                children=(Column.from_numpy(
+                    rng.integers(0, 10**6, n)),))
+    t = Table([st], ["s"])
+    p = tmp_path / "ms.orc"
+    write_orc(t, p, compression="snappy", stripe_rows=700)
+    back = porc.ORCFile(p).read()
+    assert [g["f0"] for g in back["s"].to_pylist()] == \
+        [int(v) for v in np.asarray(st.children[0].data)]

@@ -365,3 +365,96 @@ class TestListColumns:
         for chunk in ParquetChunkedReader(p, pass_read_limit=50_000):
             out.extend(chunk["l"].to_pylist())
         assert out == pyl
+
+
+# ---------------------------------------------------------------------------
+# STRUCT columns (VERDICT r3 #6)
+
+
+def test_struct_read_basic(tmp_path):
+    import pyarrow as pa
+    n = 1_000
+    rng = np.random.default_rng(4)
+    a = rng.integers(0, 10**6, n)
+    b = rng.standard_normal(n)
+    s = [f"s{i % 13}" for i in range(n)]
+    t = pa.table({
+        "plain": pa.array(np.arange(n)),
+        "st": pa.StructArray.from_arrays(
+            [pa.array(a), pa.array(b), pa.array(s)], ["a", "b", "s"]),
+    })
+    p = tmp_path / "st.parquet"
+    pq.write_table(t, p, row_group_size=300)
+    back = read_parquet(p)
+    assert back.num_rows == n
+    col = back["st"]
+    assert col.dtype.id == dt.TypeId.STRUCT
+    want = [(int(x), float(y), z) for x, y, z in zip(a, b, s)]
+    assert col.to_pylist() == want
+
+
+def test_struct_read_nulls_both_levels(tmp_path):
+    import pyarrow as pa
+    vals = [{"x": 1, "y": "a"}, None, {"x": None, "y": "c"},
+            {"x": 4, "y": None}, None, {"x": 6, "y": "f"}]
+    t = pa.table({"st": pa.array(vals,
+                                 type=pa.struct([("x", pa.int64()),
+                                                 ("y", pa.string())]))})
+    p = tmp_path / "stn.parquet"
+    pq.write_table(t, p)
+    back = read_parquet(p)
+    got = back["st"].to_pylist()
+    want = [None if v is None else (v["x"], v["y"]) for v in vals]
+    assert got == want
+
+
+@pytest.mark.parametrize("comp", ["snappy", "gzip", "zstd"])
+def test_struct_read_codecs_chunked(tmp_path, comp):
+    import pyarrow as pa
+    n = 2_000
+    rng = np.random.default_rng(5)
+    mask = rng.random(n) > 0.15
+    x = rng.integers(-10**9, 10**9, n)
+    st = pa.StructArray.from_arrays([pa.array(x)], ["x"],
+                                    mask=pa.array(~mask))
+    t = pa.table({"st": st, "k": pa.array(np.arange(n))})
+    p = tmp_path / f"stc_{comp}.parquet"
+    pq.write_table(t, p, compression=comp, row_group_size=512)
+    back = read_parquet(p)
+    got = back["st"].to_pylist()
+    want = [(int(v),) if ok else None for v, ok in zip(x, mask)]
+    assert got == want
+    assert back["k"].to_pylist() == list(range(n))
+
+
+def test_staged_read_matches_default(tmp_path):
+    """staged=True (one packed u32 transfer + jitted unpack, io/staging.py)
+    must be byte-identical to the default per-column path across every
+    word-width class (w8/w4/w2/w1) with and without validity."""
+    import pyarrow as pa
+    from spark_rapids_jni_tpu.io import write_parquet
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    n = 10_007  # odd: exercises sub-word tail padding in the staging pack
+    rng = np.random.default_rng(21)
+    valid = rng.random(n) > 0.3
+    t = Table([
+        Column.from_numpy(rng.integers(-2**50, 2**50, n), validity=valid),
+        Column.from_numpy(rng.standard_normal(n)),
+        Column.from_numpy(rng.integers(-2**30, 2**30, n).astype(np.int32)),
+        Column.from_numpy(rng.random(n).astype(np.float32)),
+        Column.from_numpy(rng.integers(-2**14, 2**14, n).astype(np.int16),
+                          validity=rng.random(n) > 0.5),
+        Column.from_numpy(rng.integers(-128, 128, n).astype(np.int8)),
+        Column.from_numpy(rng.random(n) > 0.5),
+    ], ["i64", "f64", "i32", "f32", "i16", "i8", "b"])
+    p = tmp_path / "staged.parquet"
+    write_parquet(t, p, row_group_size=2_500)
+    default = read_parquet(p)
+    staged = read_parquet(p, staged=True)
+    for nm in default.names:
+        a, b = default[nm], staged[nm]
+        assert a.dtype == b.dtype, nm
+        assert np.array_equal(np.asarray(a.data), np.asarray(b.data)), nm
+        assert np.array_equal(np.asarray(a.valid_mask()),
+                              np.asarray(b.valid_mask())), nm
+        assert a.to_pylist() == t[nm].to_pylist(), nm

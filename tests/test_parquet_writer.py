@@ -144,3 +144,92 @@ def test_nan_floats_omit_minmax_stats(tmp_path):
     assert ParquetFile(p).group_stats(0, "f") is None  # no NaN min/max
     got = pq.read_table(p).column("f").to_pylist()
     assert got[0] == 1.0 and got[2] == 5.0 and np.isnan(got[1])
+
+
+@pytest.mark.parametrize("comp", ["none", "snappy", "gzip", "zstd"])
+def test_codec_roundtrip_matrix(tmp_path, comp):
+    """VERDICT r3 #6: {type x codec} matrix, pyarrow as the independent
+    reader plus an engine self-read cross-check."""
+    rng = np.random.default_rng(8)
+    n = 5_000
+    valid = rng.random(n) > 0.2
+    t = Table([
+        Column.from_numpy(rng.integers(-2**50, 2**50, n), validity=valid),
+        Column.from_numpy(rng.standard_normal(n)),
+        Column.from_numpy(rng.integers(-2**30, 2**30, n).astype(np.int32)),
+        Column.from_numpy(rng.random(n).astype(np.float32)),
+        Column.from_numpy(rng.random(n) > 0.5),
+        Column.from_pylist([None if i % 11 == 0 else f"v{i % 37}"
+                            for i in range(n)]),
+    ], ["i64", "f64", "i32", "f32", "b", "s"])
+    p = tmp_path / f"m_{comp}.parquet"
+    write_parquet(t, p, compression=comp)
+    back = pq.read_table(p)
+    assert back.num_rows == n
+    assert back["i64"].to_pylist() == t["i64"].to_pylist()
+    assert np.allclose(np.array(back["f64"]),
+                       np.asarray(t["f64"].data).view(np.float64))
+    assert back["i32"].to_pylist() == t["i32"].to_pylist()
+    assert back["s"].to_pylist() == t["s"].to_pylist()
+    # engine reads its own file too
+    from spark_rapids_jni_tpu.io import read_parquet
+    self_back = read_parquet(p)
+    assert self_back["i64"].to_pylist() == t["i64"].to_pylist()
+    assert self_back["s"].to_pylist() == t["s"].to_pylist()
+
+
+@pytest.mark.parametrize("comp", ["gzip", "zstd"])
+def test_read_pyarrow_written_codecs(tmp_path, comp):
+    """Engine reads gzip/zstd files written by pyarrow (the common NDS
+    data codecs the r3 reader rejected)."""
+    import pyarrow as pa
+    rng = np.random.default_rng(9)
+    n = 20_000
+    t = pa.table({
+        "a": pa.array(rng.integers(0, 10**9, n)),
+        "b": pa.array(rng.standard_normal(n)),
+        "s": pa.array([f"x{i % 101}" for i in range(n)]),
+    })
+    p = tmp_path / f"pa_{comp}.parquet"
+    pq.write_table(t, p, compression=comp, row_group_size=6_000)
+    from spark_rapids_jni_tpu.io import read_parquet
+    back = read_parquet(p)
+    assert back.num_rows == n
+    assert back["a"].to_pylist() == t["a"].to_pylist()
+    assert back["s"].to_pylist() == t["s"].to_pylist()
+
+
+def test_struct_write_roundtrip(tmp_path):
+    """STRUCT write: pyarrow reads it back; engine self-read cross-check."""
+    from spark_rapids_jni_tpu import dtypes as sdt
+    n = 2_500
+    rng = np.random.default_rng(12)
+    svalid = rng.random(n) > 0.15
+    fvalid = rng.random(n) > 0.25
+    x = rng.integers(-10**9, 10**9, n)
+    y = rng.standard_normal(n)
+    st = Column(sdt.DType(sdt.TypeId.STRUCT),
+                validity=svalid,
+                children=(Column.from_numpy(x, validity=fvalid),
+                          Column.from_numpy(y)))
+    t = Table([Column.from_numpy(np.arange(n, dtype=np.int64)), st],
+              ["k", "st"])
+    p = tmp_path / "stw.parquet"
+    write_parquet(t, p, row_group_size=700)
+    back = pq.read_table(p)
+    assert back.num_rows == n
+    got = back["st"].to_pylist()
+    for i in range(n):
+        if not svalid[i]:
+            assert got[i] is None, i
+        else:
+            assert got[i]["f0"] == (int(x[i]) if fvalid[i] else None), i
+            assert abs(got[i]["f1"] - float(y[i])) < 1e-12, i
+    from spark_rapids_jni_tpu.io import read_parquet
+    sb = read_parquet(p)
+    want = [None if not svalid[i] else
+            ((int(x[i]) if fvalid[i] else None), float(y[i]))
+            for i in range(n)]
+    got2 = sb["st"].to_pylist()
+    assert [None if g is None else (g[0], round(g[1], 9)) for g in got2] == \
+        [None if w is None else (w[0], round(w[1], 9)) for w in want]
