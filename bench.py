@@ -16,10 +16,21 @@ the kernel's standalone rate.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# Pinned baseline constants (VERDICT r3 #7): vs_baseline is measured/pinned,
+# never measured/measured — see BENCH_BASELINES.json for provenance.
+with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_BASELINES.json")) as f:
+    _PINS = json.load(f)
+
+
+def pinned(metric: str) -> float:
+    return _PINS[metric]["pinned_baseline"]
 
 
 def fit_per_iter(make_loop, args, k1=16, k2=64):
@@ -114,6 +125,26 @@ def bench_row_conversion(n=2_000_000):
     per = fit_per_iter(make_loop, (datas, masks, acc0))
     dev_gbps = n * layout.row_size / per / 1e9
 
+    # Same-harness roofline: the planes-only pass (every column read, the
+    # full output-size stream produced and xor-folded) is the measured upper
+    # bound for ANY formulation of this op on this chip under this harness —
+    # it does everything except the row-interleave.  roofline_frac =
+    # headline / this.  (docs/PERF.md derives the same bound analytically.)
+    from spark_rapids_jni_tpu.ops.row_conversion import _build_planes
+
+    def make_ceiling(K):
+        def loop(d, m, acc):
+            def body(i, acc):
+                di = d[:2] + (d[2] ^ i.astype(jnp.int32),) + d[3:]
+                planes = _build_planes(layout, di, m)
+                return acc ^ jnp.concatenate(planes)
+            out = jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body, acc)
+            return out.sum(dtype=jnp.uint32)
+        return loop
+
+    per_c = fit_per_iter(make_ceiling, (datas, masks, acc0))
+    ceiling_gbps = n * layout.row_size / per_c / 1e9
+
     # CPU Arrow-style baseline (best of 3)
     cpu_s = min(
         (lambda t0: (numpy_pack(host_cols, layout),
@@ -130,7 +161,7 @@ def bench_row_conversion(n=2_000_000):
     ref = numpy_pack([(nm, d0[:ncheck], None if v0 is None else v0[:ncheck])
                       for nm, d0, v0 in host_cols], layout).reshape(-1)
     ok = bool((got == ref).all())
-    return dev_gbps, cpu_gbps, ok
+    return dev_gbps, cpu_gbps, ok, ceiling_gbps
 
 
 # ---------------------------------------------------------------------------
@@ -243,18 +274,39 @@ def bench_parquet_scan(n=2_000_000):
         list(ex.map(f._decode_group, range(f.num_row_groups)))
     decode = nbytes / (time.perf_counter() - t0) / 1e6
 
+    # measured host->device link rate (NOT assumed — VERDICT r3 weak #4:
+    # the e2e number only means something next to the link it rides)
+    import jax
+    probe = np.random.default_rng(9).integers(0, 255, 24 << 20,
+                                              dtype=np.uint8)
+    x = jax.device_put(probe); float(x[0])  # warm
+    link = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x = jax.device_put(probe); float(x[0])
+        link = max(link, probe.nbytes / (time.perf_counter() - t0) / 1e6)
+
     # end-to-end into device columns; on tunneled devices this is bounded by
-    # the host->device link (~54 MB/s here), not the scan path
+    # the host->device link, measured above and reported alongside
     t0 = time.perf_counter()
     out = read_parquet(path)
     float(out.columns[0].data.sum())  # wait for device residency
     e2e = nbytes / (time.perf_counter() - t0) / 1e6
 
+    # repeated-scan rate through the staged single-transfer path: the
+    # jitted unpack compiles on the first call (cached per schema), so a
+    # warm scan is the NDS steady-state number
+    read_parquet(path, staged=True)  # compile + first transfer
+    t0 = time.perf_counter()
+    out = read_parquet(path, staged=True)
+    float(out.columns[0].data.sum())
+    e2e_staged = nbytes / (time.perf_counter() - t0) / 1e6
+
     t0 = time.perf_counter()
     pq.read_table(path)
     arrow = nbytes / (time.perf_counter() - t0) / 1e6
     shutil.rmtree(d)
-    return decode, e2e, arrow
+    return decode, e2e, e2e_staged, arrow, link
 
 
 def bench_window(n=2_000_000):
@@ -313,6 +365,8 @@ import spark_rapids_jni_tpu
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops.join import inner_join
 from spark_rapids_jni_tpu.parallel import make_mesh, distributed_join
+from spark_rapids_jni_tpu.parallel.mesh import shard_table
+from spark_rapids_jni_tpu.parallel.shuffle import shuffle_table_padded
 rng = np.random.default_rng(3)
 nl, nr = {n_left}, {n_right}
 left = Table([Column.from_numpy(rng.integers(0, nr, nl).astype(np.int64)),
@@ -329,8 +383,21 @@ out2 = inner_join(left, right, ["k"])              # warm
 t0 = time.perf_counter(); out2 = inner_join(left, right, ["k"])
 dt_l = time.perf_counter() - t0
 assert out.num_rows == out2.num_rows
+# stage breakdown (VERDICT r3 #8): exchange-only cost on the same data,
+# measured as the standalone shuffle of each side; join = total - exchange
+lt = shard_table(left, mesh); rt = shard_table(right, mesh)
+for t in (lt, rt): shuffle_table_padded(t, mesh, ["k"])  # warm
+t0 = time.perf_counter()
+sl, okl, _ = shuffle_table_padded(lt, mesh, ["k"])
+sr, okr, _ = shuffle_table_padded(rt, mesh, ["k"])
+float(np.asarray(okl)[0]); float(np.asarray(okr)[0])
+dt_x = time.perf_counter() - t0
+xbytes = sum(int(np.asarray(c.data).nbytes) for c in sl.columns) + \
+         sum(int(np.asarray(c.data).nbytes) for c in sr.columns)
 print(json.dumps({{"dist_mrows_s": nl / dt_d / 1e6,
                    "local_mrows_s": nl / dt_l / 1e6,
+                   "exchange_s": dt_x, "total_s": dt_d,
+                   "exchange_MB": xbytes / 1e6,
                    "rows_out": drows}}))
 """
     env = dict(os.environ,
@@ -346,48 +413,89 @@ print(json.dumps({{"dist_mrows_s": nl / dt_d / 1e6,
         if r.returncode != 0 or not lines:
             print(f"distributed-join bench failed (rc={r.returncode}):\n"
                   f"{r.stderr[-2000:]}", file=_sys.stderr)
-            return None, None
-        d = json.loads(lines[-1])
-        return d["dist_mrows_s"], d["local_mrows_s"]
+            return None
+        return json.loads(lines[-1])
     except Exception as e:
         print(f"distributed-join bench failed: {e!r}", file=_sys.stderr)
-        return None, None
+        return None
 
 
 def main():
     import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
 
-    dev_gbps, cpu_gbps, ok = bench_row_conversion()
+    dev_gbps, cpu_gbps, ok, ceiling = bench_row_conversion()
     cast_dev, cast_cpu = bench_cast_strings()
     agg_dev, agg_cpu = bench_hash_aggregate()
-    scan_decode, scan_e2e, scan_arrow = bench_parquet_scan()
+    scan_decode, scan_e2e, scan_staged, scan_arrow, link = \
+        bench_parquet_scan()
     win_dev, win_cpu = bench_window()
-    smj_dist, smj_local = bench_distributed_join()
+    smj = bench_distributed_join()
 
+    # vs_baseline is measured/PINNED (BENCH_BASELINES.json), so the ratio is
+    # comparable across rounds; the live re-measure of each baseline is
+    # reported as *_measured_now for drift visibility only.
     print(json.dumps({
         "metric": "row_conversion_to_rows_GBps" + ("" if ok else "_MISMATCH"),
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
-        "vs_baseline": round(dev_gbps / cpu_gbps, 3),
+        "vs_baseline": round(
+            dev_gbps / pinned("row_conversion_to_rows_GBps"), 3),
+        "pinned_baseline": pinned("row_conversion_to_rows_GBps"),
+        "roofline_frac": round(dev_gbps / ceiling, 3),
         "extras": {
+            "row_conversion_ceiling_GBps": {
+                "value": round(ceiling, 2),
+                "note": "planes-only pass, same harness: measured upper "
+                        "bound for any formulation of this op today"},
+            "cpu_numpy_pack_measured_now_GBps": {"value": round(cpu_gbps, 3)},
             "cast_strings_to_int64_Mrows_s": {
                 "value": round(cast_dev, 2),
-                "vs_cpu_pandas": round(cast_dev / cast_cpu, 2)},
+                "pinned_baseline": pinned("cast_strings_to_int64_Mrows_s"),
+                "vs_baseline": round(
+                    cast_dev / pinned("cast_strings_to_int64_Mrows_s"), 2),
+                "cpu_measured_now": round(cast_cpu, 2)},
             "hash_aggregate_Mrows_s": {
                 "value": round(agg_dev, 2),
-                "vs_cpu_pandas": round(agg_dev / agg_cpu, 2)},
+                "pinned_baseline": pinned("hash_aggregate_Mrows_s"),
+                "vs_baseline": round(
+                    agg_dev / pinned("hash_aggregate_Mrows_s"), 2),
+                "cpu_measured_now": round(agg_cpu, 2)},
             "parquet_scan_decode_MBps": {
                 "value": round(scan_decode, 1),
-                "vs_pyarrow": round(scan_decode / scan_arrow, 3)},
+                "pinned_baseline": pinned("parquet_scan_decode_MBps"),
+                "vs_baseline": round(
+                    scan_decode / pinned("parquet_scan_decode_MBps"), 3),
+                "pyarrow_measured_now": round(scan_arrow, 1)},
             "parquet_scan_to_device_MBps": {
-                "value": round(scan_e2e, 1)},
+                "value": round(scan_e2e, 1),
+                "link_MBps_measured": round(link, 1),
+                "frac_of_link": round(scan_e2e / link, 3) if link else None},
+            "parquet_scan_to_device_staged_warm_MBps": {
+                "value": round(scan_staged, 1),
+                "frac_of_link": round(scan_staged / link, 3) if link
+                else None,
+                "note": "repeated-scan steady state: one packed transfer "
+                        "+ cached jitted unpack (io/staging.py)"},
             "window_rank_sum_Mrows_s": {
                 "value": round(win_dev, 2),
-                "vs_cpu_pandas": round(win_dev / win_cpu, 2)},
+                "pinned_baseline": pinned("window_rank_sum_Mrows_s"),
+                "vs_baseline": round(
+                    win_dev / pinned("window_rank_sum_Mrows_s"), 2),
+                "cpu_measured_now": round(win_cpu, 2)},
             **({"shuffle_smj_8dev_cpu_mesh_Mrows_s": {
-                "value": round(smj_dist, 2),
-                "vs_local_single_device": round(smj_dist / smj_local, 3)}}
-               if smj_dist else {}),
+                "value": round(smj["dist_mrows_s"], 2),
+                "pinned_baseline": pinned(
+                    "shuffle_smj_8dev_cpu_mesh_Mrows_s"),
+                "vs_baseline": round(
+                    smj["dist_mrows_s"] / pinned(
+                        "shuffle_smj_8dev_cpu_mesh_Mrows_s"), 3),
+                "local_measured_now": round(smj["local_mrows_s"], 3),
+                "breakdown_s": {
+                    "exchange": round(smj["exchange_s"], 3),
+                    "join": round(smj["total_s"] - smj["exchange_s"], 3),
+                    "total": round(smj["total_s"], 3)},
+                "exchange_MB": round(smj["exchange_MB"], 1)}}
+               if smj else {}),
         },
     }))
 

@@ -60,13 +60,18 @@ def _pallas_call(nwords: int, n: int, block_rows: int, forward: bool,
                           interpret=interpret)
 
 
+# Mosaic tiling: the plane block (nwords, B) needs B % 128 == 0 (lane dim)
+# and the wire block (B // 32, 32 * nwords) needs B // 32 % 8 == 0, so
+# blocks step in units of 256 rows; inputs are padded up to a block multiple.
+_BLOCK_ALIGN = 256
+
+
 def _pick_block_rows(n: int, nwords: int) -> int:
     # VMEM budget ~ 2 blocks in flight * 2 (in+out) * 4B * nwords * block
-    target = max(_GROUP, (2 << 20) // max(nwords * 4, 1) // _GROUP * _GROUP)
-    b = min(n, target)
-    while n % b:
-        b -= _GROUP
-    return max(b, _GROUP)
+    target = max(_BLOCK_ALIGN,
+                 (2 << 20) // max(nwords * 4, 1)
+                 // _BLOCK_ALIGN * _BLOCK_ALIGN)
+    return min(-(-n // _BLOCK_ALIGN) * _BLOCK_ALIGN, target)
 
 
 def interleave_planes(planes, *, interpret: bool = False) -> jnp.ndarray:
@@ -79,10 +84,13 @@ def interleave_planes(planes, *, interpret: bool = False) -> jnp.ndarray:
     n = planes[0].shape[0]
     if n % _GROUP:
         raise ValueError(f"n={n} not a multiple of {_GROUP}")
-    mat = jnp.stack(planes, axis=0)  # (nwords, n) — dense concat
     block = _pick_block_rows(n, nwords)
-    out = _pallas_call(nwords, n, block, True, interpret)(mat)
-    return out.reshape(-1)
+    padded = -(-n // block) * block
+    mat = jnp.stack(planes, axis=0)  # (nwords, n) — dense concat
+    if padded != n:  # pad fuses into the stack producer
+        mat = jnp.pad(mat, ((0, 0), (0, padded - n)))
+    out = _pallas_call(nwords, padded, block, True, interpret)(mat)
+    return out.reshape(-1)[:n * nwords]
 
 
 def deinterleave_wire(wire: jnp.ndarray, nwords: int, *,
@@ -92,17 +100,30 @@ def deinterleave_wire(wire: jnp.ndarray, nwords: int, *,
     if n % _GROUP:
         raise ValueError(f"n={n} not a multiple of {_GROUP}")
     block = _pick_block_rows(n, nwords)
-    mat = _pallas_call(nwords, n, block, False, interpret)(
-        wire.reshape(n // _GROUP, _GROUP * nwords))
-    return [mat[w] for w in range(nwords)]
+    padded = -(-n // block) * block
+    w2 = wire.reshape(n // _GROUP, _GROUP * nwords)
+    if padded != n:
+        w2 = jnp.pad(w2, ((0, (padded - n) // _GROUP), (0, 0)))
+    mat = _pallas_call(nwords, padded, block, False, interpret)(w2)
+    return [mat[w, :n] for w in range(nwords)]
 
 
 @functools.lru_cache(maxsize=1)
 def available() -> bool:
-    """Probe whether Mosaic can compile on this backend (cached)."""
+    """Probe whether Mosaic can compile on this backend (cached).
+
+    The probe is a REAL gridded interleave (12 words x 2 grid blocks), not a
+    toy single-block kernel: deployments exist (axon remote-compile, r4)
+    where a trivial no-grid kernel compiles but every gridded pallas_call is
+    rejected by the compile helper — a single-block probe would report
+    available and then fail on first real use."""
     try:
-        planes = [jnp.zeros((_GROUP,), jnp.uint32) for _ in range(2)]
-        np.asarray(interleave_planes(planes))
+        n = 2 * _BLOCK_ALIGN
+        mat = jnp.zeros((12, n), jnp.uint32)
+        # force block_rows = _BLOCK_ALIGN so the grid is genuinely 2 blocks
+        # (interleave_planes would auto-pick one block at this size)
+        out = _pallas_call(12, n, _BLOCK_ALIGN, True, False)(mat)
+        np.asarray(out)
         return True
     except Exception:
         return False
