@@ -29,9 +29,30 @@ if git diff --quiet build/deps.pin; then
     exit 0
 fi
 
-ci/premerge.sh
+# reviewable PR artifact (the PR half of the reference's
+# ci/submodule-sync.sh:66-117, which posts the bump + CI verdict to a PR
+# and auto-merges on green): the pin diff plus the gate result, staged
+# under target/ for whatever forge hosts the bot branch
+mkdir -p target
+{
+    echo "## dep-sync $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    echo
+    echo '```diff'
+    git diff build/deps.pin
+    echo '```'
+} > target/dep-sync-pr.md
+
+if ci/premerge.sh; then
+    echo -e "\npremerge: GREEN — safe to auto-merge" >> target/dep-sync-pr.md
+else
+    echo -e "\npremerge: RED — pin bump held (see CI log)" >> target/dep-sync-pr.md
+    git checkout -- build/deps.pin
+    echo "dep-sync: premerge failed; pins reverted, PR body in target/dep-sync-pr.md"
+    exit 1
+fi
 
 git checkout -B "$BRANCH"
 git add build/deps.pin
-git commit -m "Bump accelerator-stack pins to installed versions"
-echo "dep-sync: committed to $BRANCH (open a PR from here)"
+git commit -m "Bump accelerator-stack pins to installed versions" \
+    -m "$(cat target/dep-sync-pr.md)"
+echo "dep-sync: committed to $BRANCH (PR body: target/dep-sync-pr.md)"

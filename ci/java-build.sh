@@ -17,4 +17,18 @@ if ! command -v javac >/dev/null || ! command -v mvn >/dev/null; then
 fi
 
 mvn -B verify
-echo "java-build: OK"
+
+# NativeDepsLoader contract (reference pom.xml:362-391): the jar must carry
+# the native bridge at ${os.arch}/${os.name}/ so the loader can extract and
+# System.load it.  Fail the build if packaging silently dropped the .so.
+JAR=$(ls target/spark-rapids-jni-tpu-*.jar 2>/dev/null | grep -v sources | head -1)
+if [ -z "$JAR" ]; then
+    echo "java-build: FAIL (no jar produced)" >&2
+    exit 1
+fi
+if ! jar tf "$JAR" | grep -q 'libtpubridge.*\.so$'; then
+    echo "java-build: FAIL (jar lacks libtpubridge*.so under arch/os path)" >&2
+    jar tf "$JAR" >&2
+    exit 1
+fi
+echo "java-build: OK ($(jar tf "$JAR" | grep -c '\.so$') native libs in jar)"

