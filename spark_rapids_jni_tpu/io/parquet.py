@@ -187,9 +187,15 @@ class ColumnSchema:
     fields: tuple = ()           # STRUCT: leaf ColumnSchemas
     extra_def: int = 0           # def levels contributed by ancestors
                                  # (a leaf inside an optional struct has 1)
+    list_levels: tuple = ()      # nested LIST: per-level group optionality,
+                                 # outermost first (len >= 2 when nested;
+                                 # depth-1 lists keep the legacy fields)
 
     @property
     def max_def(self) -> int:
+        if self.list_levels:
+            return sum(1 for o in self.list_levels if o) + \
+                len(self.list_levels) + (1 if self.optional else 0)
         if self.is_list:
             return (1 if self.list_optional else 0) + 1 + \
                 (1 if self.optional else 0)
@@ -197,6 +203,8 @@ class ColumnSchema:
 
     @property
     def max_rep(self) -> int:
+        if self.list_levels:
+            return len(self.list_levels)
         return 1 if self.is_list else 0
 
 
@@ -304,22 +312,34 @@ def _interpret_schema_element(elem: dict) -> ColumnSchema | None:
 
 def _parse_list_group(elems, i: int) -> tuple[ColumnSchema, int]:
     """Standard 3-level LIST at elems[i]: optional group (LIST) { repeated
-    group g { <element> } } → (list ColumnSchema, next index)."""
-    outer = elems[i]
-    name = outer.get(4, b"").decode()
-    if outer.get(5) != 1 or i + 2 >= len(elems):
-        raise NotImplementedError(f"unsupported LIST shape at {name!r}")
-    mid = elems[i + 1]
-    if mid.get(3, 0) != 2 or mid.get(5) != 1:
-        raise NotImplementedError(
-            f"LIST {name!r} without the standard repeated middle group")
-    elem = elems[i + 2]
-    if elem.get(5):
-        raise NotImplementedError(f"nested LIST element under {name!r}")
+    group g { <element> } } → (list ColumnSchema, next index).
+
+    The element may itself be a LIST group (nested lists to any depth);
+    per-level group optionality is collected into ``list_levels``."""
+    levels = []
+    name = elems[i].get(4, b"").decode()
+    while True:
+        outer = elems[i]
+        if outer.get(5) != 1 or i + 2 >= len(elems):
+            raise NotImplementedError(f"unsupported LIST shape at {name!r}")
+        mid = elems[i + 1]
+        if mid.get(3, 0) != 2 or mid.get(5) != 1:
+            raise NotImplementedError(
+                f"LIST {name!r} without the standard repeated middle group")
+        levels.append(outer.get(3, 0) == 1)
+        elem = elems[i + 2]
+        if not elem.get(5):
+            break
+        conv, logical = elem.get(6), elem.get(10) or {}
+        if not (conv == 3 or 3 in logical):
+            raise NotImplementedError(
+                f"non-LIST group element under {name!r}")
+        i += 2  # descend into the nested LIST group
     es = _interpret_schema_element(elem)
-    return ColumnSchema(name, es.physical, es.type_length,
-                        optional=es.optional, dtype=es.dtype, is_list=True,
-                        list_optional=outer.get(3, 0) == 1), i + 3
+    return ColumnSchema(
+        name, es.physical, es.type_length, optional=es.optional,
+        dtype=es.dtype, is_list=True, list_optional=levels[0],
+        list_levels=tuple(levels) if len(levels) > 1 else ()), i + 3
 
 
 def _parse_struct_group(elems, i: int) -> tuple[ColumnSchema, int]:
@@ -622,6 +642,8 @@ class _ChunkDecoder:
         self.def_stream = (np.concatenate([d for d in defs])
                            if self.schema.extra_def and defs
                            and defs[0] is not None else None)
+        if self.schema.list_levels:
+            return self._assemble_list_nested(reps, defs, vals)
         if self.schema.is_list:
             return self._assemble_list(reps, defs, vals)
         return self._assemble(defs, vals)
@@ -754,6 +776,70 @@ class _ChunkDecoder:
         child = _HostColumn(ecs, values, chars, offsets, elem_valid)
         return _HostColumn(s, None, None, None, row_valid, child=child,
                            loffsets=loffsets.astype(np.int32))
+
+    def _assemble_list_nested(self, reps, defs, vals) -> _HostColumn:
+        """Arbitrary-depth LIST reconstruction from rep/def level streams.
+
+        Level math (generalizing the 3-level case above): with per-level
+        group optionality o_1..o_D, C_k = sum_{j<=k}(1 + o_j) is the
+        definition level at which an element SLOT exists at depth k; the
+        level-k list hanging at a depth-(k-1) slot is null iff
+        def < C_{k-1} + o_k, and every event with rep < k opens a level-k
+        segment (dead segments — whose first def < C_{k-1} — belong to no
+        parent slot and are dropped)."""
+        s = self.schema
+        o = [1 if x else 0 for x in s.list_levels]
+        depth = len(o)
+        C = [0]
+        for ok in o:
+            C.append(C[-1] + 1 + ok)
+        md = s.max_def
+        rep = np.concatenate([r for r in reps]) if reps else \
+            np.zeros(0, np.int32)
+        deff = np.concatenate([d for d in defs]) if defs else \
+            np.zeros(0, np.int32)
+        nev = len(rep)
+        top = prev = None
+        for k in range(1, depth + 1):
+            seg = np.flatnonzero(rep < k)
+            first_def = deff[seg]
+            keep = first_def >= C[k - 1]       # parent slot exists
+            # a NEW level-k element starts only where rep <= k (deeper rep
+            # values continue an existing slot at this level)
+            slot = (rep <= k) & (deff >= C[k])
+            cs = np.concatenate(([0], np.cumsum(slot, dtype=np.int64)))
+            seg_end = np.concatenate((seg[1:], [nev])) if len(seg) else \
+                np.zeros(0, np.int64)
+            lens = (cs[seg_end] - cs[seg])[keep]
+            valid_k = (first_def >= C[k - 1] + o[k - 1])[keep]
+            loff = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=loff[1:])
+            if loff[-1] > np.iinfo(np.int32).max:
+                raise ValueError("nested list chunk exceeds int32 offsets")
+            lcs = ColumnSchema(s.name + ".list" * (k - 1), s.physical,
+                               s.type_length, optional=s.optional,
+                               dtype=s.dtype, is_list=True,
+                               list_optional=bool(o[k - 1]))
+            hc = _HostColumn(lcs, None, None, None,
+                             None if bool(valid_k.all()) else valid_k,
+                             loffsets=loff.astype(np.int32))
+            if prev is None:
+                top = hc
+            else:
+                prev.child = hc
+            prev = hc
+        slot_leaf = deff >= C[depth]
+        nslots = int(slot_leaf.sum())
+        elem_valid = None
+        if s.optional:
+            elem_valid = (deff == md)[slot_leaf]
+            if bool(elem_valid.all()):
+                elem_valid = None
+        ecs = ColumnSchema(s.name + ".element", s.physical, s.type_length,
+                           optional=s.optional, dtype=s.dtype)
+        values, chars, offsets = _scatter_values(s, nslots, vals, elem_valid)
+        prev.child = _HostColumn(ecs, values, chars, offsets, elem_valid)
+        return top
 
 
 # ---------------------------------------------------------------------------
@@ -1005,6 +1091,20 @@ class ParquetChunkedReader:
                (lo is not None and gmax < lo)
 
     def _chunks(self):
+        from ..utils.memory import MemoryScope
+        # the live-buffer census walks every live jax.Array, so per-batch
+        # checkpoints only run when the observability is actually wanted
+        if not os.environ.get("SRJT_MEM_DEBUG"):
+            yield from self._chunks_raw()
+            return
+        with MemoryScope("parquet_chunked") as scope:
+            for tbl in self._chunks_raw():
+                yield tbl
+                # RMM-role checkpoint: refresh the working-set high-water
+                # mark at the batch boundary
+                scope.checkpoint()
+
+    def _chunks_raw(self):
         for gi in range(self.file.num_row_groups):
             if self._group_pruned(gi):
                 continue

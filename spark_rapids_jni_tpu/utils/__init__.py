@@ -1,5 +1,6 @@
 from . import bitmask
 from . import config
+from . import memory
 from . import tracing
 
-__all__ = ["bitmask", "config", "tracing"]
+__all__ = ["bitmask", "config", "memory", "tracing"]

@@ -1,0 +1,116 @@
+"""Device-memory observability and budgets (the RMM role).
+
+The reference threads an ``rmm::mr::device_memory_resource*`` through
+every op (reference src/main/cpp/src/row_conversion.hpp:30-36) so callers
+control and observe allocation.  Under XLA the allocator belongs to the
+runtime, so the TPU-native analog is split the way the rest of the design
+splits host/device responsibilities:
+
+- *control* lives in the size-bounded entry points that already exist
+  (``convert_to_rows`` max_batch_bytes, ``ParquetChunkedReader``
+  pass_read_limit, shuffle capacities) — the working set is bounded by
+  construction, not by a custom allocator;
+- *observability* lives here: a live-buffer census over ``jax.live_arrays``
+  plus scoped high-water tracking, and an optional budget guard that turns
+  "the working set grew past X" into an exception at the checkpoints the
+  engine already passes through.
+
+Env: ``SRJT_MEM_DEBUG=1`` logs every scope's high-water mark to stderr
+(the RMM_LOGGING_LEVEL analog, reference pom.xml:81).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+import jax
+
+
+def _array_nbytes(a) -> int:
+    try:
+        return a.nbytes
+    except Exception:
+        return 0
+
+
+def device_memory_stats(platform: str | None = None) -> dict:
+    """Census of live device buffers: {live_bytes, live_arrays}.
+
+    ``platform`` filters to one backend (e.g. "tpu"); default counts every
+    live jax.Array in the process."""
+    total = 0
+    count = 0
+    for a in jax.live_arrays(platform):
+        total += _array_nbytes(a)
+        count += 1
+    return {"live_bytes": total, "live_arrays": count}
+
+
+@dataclass
+class ScopeStats:
+    name: str
+    start_bytes: int = 0
+    high_water_bytes: int = 0
+    end_bytes: int = 0
+
+    @property
+    def delta_bytes(self) -> int:
+        return self.end_bytes - self.start_bytes
+
+
+class BudgetExceeded(RuntimeError):
+    """Working set grew past the scope's budget at a checkpoint."""
+
+
+class MemoryScope:
+    """Scoped live-byte tracking with optional budget enforcement.
+
+    The engine's long-running paths call ``checkpoint()`` at their natural
+    batch boundaries (the places the reference would consult its memory
+    resource); a checkpoint refreshes the high-water mark and raises
+    ``BudgetExceeded`` when a budget is set and breached.
+    """
+
+    def __init__(self, name: str = "scope", budget_bytes: int | None = None,
+                 platform: str | None = None):
+        self.stats = ScopeStats(name)
+        self.budget = budget_bytes
+        self.platform = platform
+
+    def __enter__(self) -> "MemoryScope":
+        self.stats.start_bytes = device_memory_stats(
+            self.platform)["live_bytes"]
+        self.stats.high_water_bytes = self.stats.start_bytes
+        return self
+
+    def checkpoint(self) -> int:
+        live = device_memory_stats(self.platform)["live_bytes"]
+        if live > self.stats.high_water_bytes:
+            self.stats.high_water_bytes = live
+        if self.budget is not None and live > self.budget:
+            raise BudgetExceeded(
+                f"{self.stats.name}: live device bytes {live} exceed "
+                f"budget {self.budget}")
+        return live
+
+    def __exit__(self, *exc):
+        self.stats.end_bytes = device_memory_stats(
+            self.platform)["live_bytes"]
+        if self.stats.end_bytes > self.stats.high_water_bytes:
+            self.stats.high_water_bytes = self.stats.end_bytes
+        if os.environ.get("SRJT_MEM_DEBUG"):
+            s = self.stats
+            print(f"[mem] {s.name}: start={s.start_bytes} "
+                  f"high={s.high_water_bytes} end={s.end_bytes} "
+                  f"delta={s.delta_bytes}", file=sys.stderr, flush=True)
+        return False
+
+
+@contextmanager
+def track(name: str = "scope", budget_bytes: int | None = None):
+    """``with memory.track("join") as scope: ...`` — scoped census."""
+    with MemoryScope(name, budget_bytes) as scope:
+        yield scope
