@@ -53,6 +53,9 @@ fi
 
 git checkout -B "$BRANCH"
 git add build/deps.pin
-git commit -m "Bump accelerator-stack pins to installed versions" \
+# bot identity: CI runners have no configured author (reference bot
+# pattern, ci/submodule-sync.sh)
+git -c user.name="dep-sync-bot" -c user.email="dep-sync-bot@invalid" \
+    commit -m "Bump accelerator-stack pins to installed versions" \
     -m "$(cat target/dep-sync-pr.md)"
 echo "dep-sync: committed to $BRANCH (PR body: target/dep-sync-pr.md)"

@@ -30,9 +30,9 @@ build/build-info
 
 python -m pip wheel --no-deps --no-build-isolation -w "$OUT" . \
     || python -m pip wheel --no-deps -w "$OUT" .
-# sdist when the build backend is available; wheels alone are deployable
-python -m pip download --no-deps --no-binary :all: -d /dev/null . \
-    2>/dev/null || true
+# sdist when the `build` frontend is installed; wheels alone are deployable
+python -m build --sdist -o "$OUT" . 2>/dev/null \
+    || echo "deploy: sdist skipped (python -m build not installed)"
 
 if command -v javac >/dev/null 2>&1 && command -v mvn >/dev/null 2>&1; then
     mvn -B -DskipTests package
@@ -47,7 +47,12 @@ fi
 
 if [ -n "${DEPLOY_REPO_URL:-}" ]; then
     if command -v twine >/dev/null 2>&1; then
-        twine upload --repository-url "$DEPLOY_REPO_URL" "$OUT"/*.whl
+        # wheels + sdists (twine ships sibling .asc signatures when
+        # present); the jar deploys to a maven repo, not pypi — it stays
+        # staged for the release engineer like the reference's classifier
+        # jars
+        twine upload --repository-url "$DEPLOY_REPO_URL" \
+            "$OUT"/*.whl $(ls "$OUT"/*.tar.gz 2>/dev/null || true)
     else
         echo "deploy: DEPLOY_REPO_URL set but twine missing" >&2
         exit 1
