@@ -115,9 +115,16 @@ def _decompress_chunk(chunk: bytes, kind: int) -> bytes:
         # raw-format snappy carries its decompressed length in the preamble;
         # pyarrow's Codec insists on being told, so use the in-repo decoder
         return _snappy_py.decompress(chunk)
+    if kind == COMP_ZSTD:
+        import pyarrow as _pa
+        # stream-decode: pyarrow's one-shot Codec.decompress demands an
+        # explicit decompressed size, which ORC chunk framing doesn't carry
+        with _pa.input_stream(_pa.BufferReader(chunk),
+                              compression="zstd") as st:
+            return st.read()
     raise NotImplementedError(
         f"unsupported ORC compression kind {kind} "
-        "(NONE, ZLIB and SNAPPY are supported)")
+        "(NONE, ZLIB, SNAPPY and ZSTD are supported)")
 
 
 def _decode_stream(raw: bytes, kind: int) -> bytes:

@@ -18,7 +18,7 @@ import numpy as np
 from .. import dtypes as dt
 from ..columnar import Table
 from ..ops.selection import gather_column
-from .orc import (COMP_NONE, COMP_SNAPPY, COMP_ZLIB, SK_DATA, SK_LENGTH, SK_PRESENT,
+from .orc import (COMP_NONE, COMP_SNAPPY, COMP_ZLIB, COMP_ZSTD, SK_DATA, SK_LENGTH, SK_PRESENT,
                   SK_SECONDARY, TK_BOOLEAN, TK_BYTE, TK_DATE, TK_DECIMAL,
                   TK_DOUBLE, TK_FLOAT, TK_INT, TK_LIST, TK_LONG, TK_SHORT,
                   TK_STRING, TK_STRUCT, TK_TIMESTAMP, _ORC_EPOCH_S)
@@ -377,8 +377,10 @@ def _column_streams(col, dtype: dt.DType) -> list[tuple[int, bytes]]:
 try:
     import pyarrow as _pa
     _SNAPPY_C = _pa.Codec("snappy")  # compressor (decoder lives in io.snappy)
+    _ZSTD_C = _pa.Codec("zstd")
 except Exception:  # pragma: no cover - pyarrow is baked into this env
     _SNAPPY_C = None
+    _ZSTD_C = None
 
 
 def _compress_stream(raw: bytes, kind: int, block: int) -> bytes:
@@ -390,6 +392,8 @@ def _compress_stream(raw: bytes, kind: int, block: int) -> bytes:
         if kind == COMP_ZLIB:
             comp = zlib.compressobj(6, zlib.DEFLATED, -15)
             cb = comp.compress(chunk) + comp.flush()
+        elif kind == COMP_ZSTD:
+            cb = _ZSTD_C.compress(chunk).to_pybytes()
         else:  # COMP_SNAPPY
             cb = _SNAPPY_C.compress(chunk).to_pybytes()
         if len(cb) < len(chunk):
@@ -416,6 +420,8 @@ def write_orc(table: Table, path, compression: str = "none",
              "zlib": COMP_ZLIB}
     if _SNAPPY_C is not None:
         kinds["snappy"] = COMP_SNAPPY
+    if _ZSTD_C is not None:
+        kinds["zstd"] = COMP_ZSTD
     comp = kinds[compression.lower()]
     block = 64 * 1024
     names = [nm or f"c{i}" for i, nm in enumerate(

@@ -13,6 +13,8 @@ Supported window ops (Spark names):
 - ``percent_rank`` / ``cume_dist``      relative rank / cumulative share
 - ``ntile`` (buckets k)                 Spark bucket assignment
 - ``lag`` / ``lead`` (offset k)         null outside the partition
+- ``first_value`` / ``last_value``      over the default frame: partition
+  head / end of the current peer run
 - ``sum`` / ``min`` / ``max`` / ``count`` / ``mean``
   running aggregates over Spark's default frame: RANGE UNBOUNDED
   PRECEDING .. CURRENT ROW — rows tied on the order keys (peers) share
@@ -47,7 +49,7 @@ def window_out_dtype(col_dtype, op: str):
     """Result dtype of a window op (shared with parallel.distributed)."""
     if op in ("row_number", "rank", "dense_rank", "count", "ntile"):
         return INT64
-    if op in ("lag", "lead", "min", "max"):
+    if op in ("lag", "lead", "min", "max", "first_value", "last_value"):
         return col_dtype
     if op in ("mean", "percent_rank", "cume_dist"):
         return FLOAT64
@@ -315,6 +317,21 @@ def window(table: Table, partition_by: list, order_by: list,
                 sseg = _shift_up(seg, k, jnp.int32(-1))
             ok = (sseg == seg) & shv
             out_sorted.append((col.dtype, shifted, ok))
+        elif op in ("first_value", "last_value"):
+            # Spark default frame (RANGE UNBOUNDED PRECEDING..CURRENT ROW):
+            # first_value is the partition's first row's value; last_value
+            # is the value at the END of the current peer run
+            slot = slot_of[id(col)]
+            sval, sv = sdata[slot], svalid[slot]
+            if op == "first_value":
+                fv = _seg_scan(sval, seg, lambda cur, prev: prev,
+                               jnp.zeros((), sval.dtype))
+                fvv = _seg_scan(sv, seg, lambda cur, prev: prev,
+                                jnp.zeros((), jnp.bool_))
+            else:
+                fv = peer_fill(sval, jnp.zeros((), sval.dtype))
+                fvv = peer_fill(sv, jnp.zeros((), jnp.bool_))
+            out_sorted.append((col.dtype, fv, fvv))
         elif op in ("rolling_sum", "rolling_count", "rolling_mean"):
             # ROWS-frame bounded window via prefix differences: the sum over
             # [i-k+1, i] is ps[i] - ps[i-k], with rows from another segment

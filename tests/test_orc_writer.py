@@ -17,7 +17,7 @@ from spark_rapids_jni_tpu.io import read_orc, write_orc
 EPOCH_DATE = datetime.date(1970, 1, 1)
 
 
-@pytest.mark.parametrize("comp", ["none", "zlib"])
+@pytest.mark.parametrize("comp", ["none", "zlib", "zstd"])
 def test_mixed_roundtrip_via_pyarrow(tmp_path, comp):
     rng = np.random.default_rng(0)
     n = 10_000
@@ -52,6 +52,11 @@ def test_mixed_roundtrip_via_pyarrow(tmp_path, comp):
     assert back["b"].to_pylist() == [bool(v) for v in
                                      np.asarray(t["b"].data)]
     assert back["s"].to_pylist() == t["s"].to_pylist()
+    # engine self-read cross-check (the zstd path once passed via the
+    # pyarrow oracle alone while read_orc raised)
+    sb = read_orc(p)
+    assert sb["i64"].to_pylist() == t["i64"].to_pylist()
+    assert sb["s"].to_pylist() == t["s"].to_pylist()
 
 
 def test_timestamps_all_precisions_and_signs(tmp_path):

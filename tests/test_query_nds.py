@@ -258,3 +258,76 @@ def test_q67_lite_topn_per_group(tmp_path):
                             [round(s, 6) for s in want.sales]))
     assert got_sales == want_sales
     assert len(got_keys) == len(want_keys)
+
+
+# ---------------------------------------------------------------------------
+# q97-lite: the TPC-DS full-outer-join query (channel overlap counting)
+
+
+@pytest.fixture(scope="module")
+def q97_warehouse(tmp_path_factory):
+    root = tmp_path_factory.mktemp("q97")
+    rng = np.random.default_rng(97)
+    n_ss, n_cs = 30_000, 25_000
+    ss = pd.DataFrame({
+        "ss_customer_sk": rng.integers(1, 3_000, n_ss),
+        "ss_item_sk": rng.integers(1, 500, n_ss),
+        "ss_sold_date_sk": rng.integers(D_LO - 50, D_HI + 50, n_ss),
+    })
+    cs = pd.DataFrame({
+        "cs_bill_customer_sk": rng.integers(1, 3_000, n_cs),
+        "cs_item_sk": rng.integers(1, 500, n_cs),
+        "cs_sold_date_sk": rng.integers(D_LO - 50, D_HI + 50, n_cs),
+    })
+    pq.write_table(pa.Table.from_pandas(ss), root / "store_sales.parquet",
+                   compression="zstd")
+    pq.write_table(pa.Table.from_pandas(cs), root / "catalog_sales.parquet",
+                   compression="gzip")
+    return root, ss, cs
+
+
+def q97_oracle(ss, cs):
+    """SELECT sum(store_only), sum(catalog_only), sum(both) FROM
+    (distinct store (cust,item)) FULL OUTER JOIN (distinct catalog ...)"""
+    s = ss[(ss.ss_sold_date_sk >= D_LO) & (ss.ss_sold_date_sk <= D_HI)][
+        ["ss_customer_sk", "ss_item_sk"]].drop_duplicates()
+    c = cs[(cs.cs_sold_date_sk >= D_LO) & (cs.cs_sold_date_sk <= D_HI)][
+        ["cs_bill_customer_sk", "cs_item_sk"]].drop_duplicates()
+    m = pd.merge(s, c, how="outer",
+                 left_on=["ss_customer_sk", "ss_item_sk"],
+                 right_on=["cs_bill_customer_sk", "cs_item_sk"],
+                 indicator=True)
+    return ((m["_merge"] == "left_only").sum(),
+            (m["_merge"] == "right_only").sum(),
+            (m["_merge"] == "both").sum())
+
+
+def test_q97_lite_matches_pandas(q97_warehouse):
+    from spark_rapids_jni_tpu.ops.join import full_join
+    from spark_rapids_jni_tpu.ops.selection import distinct
+    root, ss_df, cs_df = q97_warehouse
+
+    def scan_filter(name, date_col, keys):
+        t = read_parquet(root / name)
+        d = t[date_col].data
+        t = apply_boolean_mask(t, (d >= D_LO) & (d <= D_HI))
+        from spark_rapids_jni_tpu.columnar import Table as _T
+        return distinct(_T([t[k] for k in keys], keys))
+
+    ssk = scan_filter("store_sales.parquet", "ss_sold_date_sk",
+                      ["ss_customer_sk", "ss_item_sk"])
+    csk = scan_filter("catalog_sales.parquet", "cs_sold_date_sk",
+                      ["cs_bill_customer_sk", "cs_item_sk"])
+    out = full_join(ssk, csk, ["ss_customer_sk", "ss_item_sk"],
+                    ["cs_bill_customer_sk", "cs_item_sk"])
+    # both sides are distinct key sets, so the channel-overlap counts fall
+    # out of the outer-join cardinality (inclusion-exclusion)
+    n_left = ssk.num_rows
+    n_right = csk.num_rows
+    n_out = out.num_rows
+    both = n_left + n_right - n_out
+    store_only = n_left - both
+    catalog_only = n_right - both
+    w_store, w_cat, w_both = q97_oracle(ss_df, cs_df)
+    assert (store_only, catalog_only, both) == (w_store, w_cat, w_both)
+

@@ -308,3 +308,31 @@ def test_rolling_nan_isolated_to_containing_windows():
     assert got[0] == 1.0
     assert np.isnan(got[1]) and np.isnan(got[2])  # windows containing NaN
     assert got[3:] == [10.0, 30.0, 50.0]          # other partition untouched
+
+
+def test_first_last_value():
+    """Spark default frame: first_value = partition head; last_value = end
+    of the current RANGE peer run."""
+    from spark_rapids_jni_tpu.ops.window import window
+    p = [1, 1, 1, 1, 2, 2]
+    o = [10, 20, 20, 30, 5, 5]
+    v = [7, None, 3, 4, 9, 2]
+    t = Table([Column.from_pylist(p), Column.from_pylist(o),
+               Column.from_pylist(v)], ["p", "o", "v"])
+    out = window(t, ["p"], ["o"], [("v", "first_value"), ("v", "last_value")])
+    keyf = lambda r: tuple((x is None, x) for x in r)
+    got = sorted(zip(out["p"].to_pylist(), out["o"].to_pylist(),
+                     out["v"].to_pylist(),
+                     out["first_value_v"].to_pylist(),
+                     out["last_value_v"].to_pylist()), key=keyf)
+    # peers (1,20): last_value = value of the LAST peer row (stable order:
+    # None then 3 -> last is 3); partition 2 peers (5,5): last is 2
+    want = sorted([
+        (1, 10, 7, 7, 7),
+        (1, 20, None, 7, 3),
+        (1, 20, 3, 7, 3),
+        (1, 30, 4, 7, 4),
+        (2, 5, 9, 9, 2),
+        (2, 5, 2, 9, 2),
+    ], key=keyf)
+    assert got == want
