@@ -32,7 +32,7 @@ from ..dtypes import DType, TypeId, INT64, FLOAT64
 from ..ops.aggregate import groupby_padded
 from ..ops.row_conversion import fixed_width_layout, _build_planes, \
     _from_planes
-from .mesh import ROW_AXIS
+from .mesh import ROW_AXIS, axis_size
 from ..utils.tracing import traced
 from .shuffle import (partition_ids, cap_bucket, exchange_planes,
                       partition_counts)
@@ -115,7 +115,7 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
     pass — without this they would form a spurious null-key group and
     corrupt genuine null-key aggregates.
     """
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
     partial_specs, final_plan = _expand_aggs(aggs)
     # var/std moment partials are computed over globally mean-shifted values
     # (variance is shift-invariant; without the shift the (Σx², Σx) combine
@@ -265,7 +265,7 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
     returned; the host wrapper assembles and compacts.
     """
     from ..ops.join import inner_join_padded
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
     llayout = fixed_width_layout(list(lschema))
     rlayout = fixed_width_layout(list(rschema))
 
@@ -392,7 +392,7 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
     from ..ops.strings_common import string_width_bucket
     on_right = list(on_right or on_left)
     on_left = list(on_left)
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
 
     def _key_width(t, k):
         c = t.column(k)
@@ -541,7 +541,7 @@ def distributed_cross_join(left: Table, right: Table, mesh: Mesh,
     unspecified, as in Spark."""
     from .mesh import pad_to_multiple, shard_table
     from .stringplane import explode_strings, reassemble_strings
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
     lt, lplan = (explode_strings(left)
                  if any(c.dtype.is_string for c in left.columns)
                  else (left, None))
@@ -632,7 +632,7 @@ def distributed_window(table: Table, mesh: Mesh, partition_by: list,
     """
     from .mesh import pad_to_multiple, shard_table
     from .shuffle import shuffle_table_padded
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
     t = table
     live = None
     if t.num_rows % ndev:
@@ -706,7 +706,7 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
     (length, byte-word) multi-keys, reassembled on the way out.
     """
     from .mesh import pad_to_multiple, shard_table
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
 
     orig_keys = list(key_names)
     orig_aggs = list(aggs)

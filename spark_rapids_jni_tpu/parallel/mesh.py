@@ -16,12 +16,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..columnar import Column, Table
 
 ROW_AXIS = "shard"
+DCN_AXIS = "dcn"
 
 
 def make_mesh(n_devices: int | None = None, axis: str = ROW_AXIS) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
     return Mesh(np.array(devs[:n]), (axis,))
+
+
+def make_multislice_mesh(n_slices: int, chips_per_slice: int,
+                         dcn_axis: str = DCN_AXIS,
+                         ici_axis: str = ROW_AXIS) -> Mesh:
+    """(n_slices, chips_per_slice) mesh: the multi-host/multi-slice layout.
+
+    Row data shards over BOTH axes (pass ``axis=(dcn_axis, ici_axis)`` to
+    the distributed entry points); XLA routes the per-slice legs of each
+    collective over ICI and the cross-slice legs over DCN — the multi-host
+    scaling story the reference delegates to Spark+NCCL at L6 (SURVEY.md
+    §2.3 last row).  Device order: ``jax.devices()`` is contiguous per
+    slice/host, so the major mesh axis is the slice boundary."""
+    devs = jax.devices()
+    need = n_slices * chips_per_slice
+    if len(devs) < need:
+        raise ValueError(f"mesh wants {need} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:need]).reshape(n_slices, chips_per_slice),
+                (dcn_axis, ici_axis))
+
+
+def axis_size(mesh: Mesh, axis) -> int:
+    """Total shard count over one axis name or a tuple of axis names."""
+    if isinstance(axis, tuple):
+        return int(np.prod([mesh.shape[a] for a in axis]))
+    return mesh.shape[axis]
 
 
 def pad_to_multiple(table: Table, multiple: int) -> tuple[Table, int]:

@@ -36,7 +36,7 @@ from ..columnar import Column, Table
 from ..ops.hash import murmur3_hash
 from ..ops.row_conversion import (RowLayout, _build_planes,
                                   _from_planes)
-from .mesh import ROW_AXIS
+from .mesh import ROW_AXIS, axis_size
 from ..utils.tracing import traced
 
 
@@ -129,7 +129,7 @@ def make_partition_counts(mesh: Mesh, key_idx: tuple[int, ...],
     sizes the payload exchange exactly.  Returns fn(datas, masks[, n_valid])
     -> int32[ndev, ndev] with row s = counts shard s sends to each dest.
     """
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
 
     def shard_fn(datas, masks, n_valid=None):
         key_cols = [Column(kd, data=datas[i],
@@ -206,7 +206,7 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
     a shuffle's working set is ~1x instead of 2x.  Callers must not touch
     the donated table afterwards.
     """
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
 
     def shard_fn(datas, masks, row_mask):
         key_cols = [Column(kd, data=datas[i],
@@ -259,7 +259,7 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
         from .mesh import shard_table
         table = shard_table(table, mesh, axis)  # strings couldn't shard before
     layout = fixed_width_layout(table.dtypes())
-    ndev = mesh.shape[axis]
+    ndev = axis_size(mesh, axis)
     names = table.names or [f"c{i}" for i in range(table.num_columns)]
     key_idx = tuple(names.index(k) if isinstance(k, str) else int(k)
                     for k in keys)
