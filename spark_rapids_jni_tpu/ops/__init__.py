@@ -27,6 +27,7 @@ from .selection import (  # noqa: F401
     slice_table,
 )
 from .aggregate import groupby  # noqa: F401
+from .cast import cast  # noqa: F401
 from .join import (  # noqa: F401
     inner_join, left_join, right_join, full_join, cross_join,
     left_semi_join, left_anti_join, sort_merge_join,

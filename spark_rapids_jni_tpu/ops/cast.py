@@ -1,0 +1,218 @@
+"""General column casts (the cudf::cast role, SURVEY.md §2.2 "algorithms").
+
+Spark non-ANSI cast semantics over the engine's dtype system:
+
+- integral -> integral: two's-complement narrowing (Java semantics);
+- float -> integral: truncate toward zero, NaN -> 0, +/-inf and
+  out-of-range saturate to the target min/max (JVM double-to-long rules);
+- integral/bool -> float and float widths: value conversion;
+- numeric <-> BOOL8: zero is false, nonzero is true; bool -> 0/1;
+- timestamps: unit rescale (truncating toward negative infinity on
+  downscale, Spark's instant semantics); DATE <-> timestamp via day
+  boundaries;
+- decimals: scale change by powers of ten — values that cannot be
+  represented exactly at the target scale, or that overflow the target
+  width, become null (Spark's non-ANSI overflow-to-null);
+- STRING directions delegate to ops.cast_strings (the reference's
+  CastStrings component).
+
+FLOAT64 columns store IEEE bit patterns device-side (dtypes.device_storage);
+casts go through ``float_values()``/``Column.fixed`` so the bit-pattern
+convention never leaks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import Column
+from ..dtypes import DType, TypeId
+from ..utils.tracing import traced
+
+_TS_UNIT = {
+    TypeId.TIMESTAMP_SECONDS: 10**9,
+    TypeId.TIMESTAMP_MILLISECONDS: 10**6,
+    TypeId.TIMESTAMP_MICROSECONDS: 10**3,
+    TypeId.TIMESTAMP_NANOSECONDS: 1,
+}
+
+_INT_IDS = (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64,
+            TypeId.UINT8, TypeId.UINT16, TypeId.UINT32, TypeId.UINT64)
+
+
+def _num_values(col: Column) -> jnp.ndarray:
+    if col.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return col.float_values()
+    if col.dtype.id == TypeId.BOOL8:
+        return col.data.astype(jnp.int64)
+    return col.data
+
+
+@traced("cast")
+def cast(col: Column, to: DType, ansi: bool = False) -> Column:
+    """Cast a column to ``to`` with Spark non-ANSI semantics (see module
+    docstring); ``ansi=True`` is accepted for the string directions that
+    support it (delegated to ops.cast_strings)."""
+    f = col.dtype
+    if f == to:
+        return col
+
+    # ---- string directions: the CastStrings component owns these
+    if f.is_string:
+        from . import cast_strings as cs
+        if to.id in _INT_IDS:
+            return cs.cast_to_integer(col, to, ansi=ansi)
+        if to.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return cs.cast_to_float(col, to, ansi=ansi)
+        if to.is_decimal:
+            return cs.cast_to_decimal(col, to, ansi=ansi)
+        if to.id == TypeId.BOOL8:
+            return cs.cast_to_bool(col, ansi=ansi)
+        raise NotImplementedError(f"cast STRING -> {to!r}")
+    if to.is_string:
+        from . import cast_strings as cs
+        if f.id in _INT_IDS:
+            return cs.cast_from_integer(col)
+        raise NotImplementedError(f"cast {f!r} -> STRING (only integral "
+                                  "sources format; others via host)")
+
+    # ---- timestamps
+    if f.is_timestamp and to.is_timestamp:
+        if TypeId.TIMESTAMP_DAYS in (f.id, to.id):
+            day_ns = 86_400 * 10**9
+            if f.id == TypeId.TIMESTAMP_DAYS:
+                ns = col.data.astype(jnp.int64) * day_ns
+                out = ns // _TS_UNIT[to.id]
+            else:
+                ns = col.data.astype(jnp.int64) * _TS_UNIT[f.id]
+                out = jnp.floor_divide(ns, day_ns).astype(jnp.int32)
+            return Column.fixed(to, out, validity=col.validity)
+        uf, ut = _TS_UNIT[f.id], _TS_UNIT[to.id]
+        v = col.data.astype(jnp.int64)
+        out = v * (uf // ut) if uf >= ut else jnp.floor_divide(v, ut // uf)
+        return Column.fixed(to, out, validity=col.validity)
+
+    # ---- decimals: rescale with overflow/precision-loss -> null
+    if f.is_decimal or to.is_decimal:
+        return _cast_decimal(col, to)
+
+    # ---- numeric / bool
+    if to.id == TypeId.BOOL8:
+        v = _num_values(col)
+        return Column.fixed(to, (v != 0), validity=col.validity)
+    if to.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        v = _num_values(col).astype(
+            jnp.float32 if to.id == TypeId.FLOAT32 else jnp.float64)
+        return Column.fixed(to, v, validity=col.validity)
+    if to.id in _INT_IDS:
+        import numpy as np
+        tdt = jnp.dtype(to.storage)
+        if f.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            v = col.float_values().astype(jnp.float64)
+            info = jnp.iinfo(tdt)
+            # JVM double->integral: NaN -> 0, truncate toward zero,
+            # out-of-range saturates EXACTLY to min/max.  float(info.max)
+            # rounds up to 2**63 for 64-bit targets (astype would wrap),
+            # so saturate with explicit selects on safely-representable
+            # bounds before the convert.
+            t = jnp.where(jnp.isnan(v), 0.0, jnp.trunc(v))
+            hi = float(np.nextafter(np.float64(info.max), 0.0)) \
+                if tdt.itemsize == 8 else float(info.max)
+            lo = float(info.min)
+            over = t >= float(info.max) if tdt.itemsize == 8 \
+                else t > float(info.max)
+            under = t < lo
+            safe = jnp.clip(t, lo, hi).astype(jnp.int64)
+            out = jnp.where(over, jnp.int64(info.max),
+                            jnp.where(under, jnp.int64(info.min), safe))
+            return Column.fixed(to, out.astype(tdt), validity=col.validity)
+        v = _num_values(col)
+        # two's-complement narrowing (Java semantics): wrap via the
+        # unsigned view of the target width
+        bits = tdt.itemsize * 8
+        if bits < 64:
+            wrapped = v.astype(jnp.int64) & jnp.int64((1 << bits) - 1)
+            if tdt.kind == "i":
+                sign = jnp.int64(1 << (bits - 1))
+                wrapped = (wrapped ^ sign) - sign
+        else:
+            wrapped = v.astype(jnp.int64)
+        return Column.fixed(to, wrapped.astype(tdt), validity=col.validity)
+    raise NotImplementedError(f"cast {f!r} -> {to!r}")
+
+
+def _div_half_up(iv: jnp.ndarray, q) -> jnp.ndarray:
+    """Integer divide rounding half away from zero (Spark HALF_UP)."""
+    a = jnp.abs(iv)
+    m = (a + q // 2) // q
+    return jnp.where(iv >= 0, m, -m)
+
+
+def _cast_decimal(col: Column, to: DType) -> Column:
+    f = col.dtype
+    if f.id == TypeId.DECIMAL128 or to.id == TypeId.DECIMAL128:
+        raise NotImplementedError("DECIMAL128 casts: use host-side "
+                                  "rescale (arbitrary precision)")
+    fs = f.scale if f.is_decimal else 0
+    ts = to.scale if to.is_decimal else 0
+    valid = col.valid_mask()
+    if f.is_decimal and not to.is_decimal:
+        # decimal -> numeric: value = mantissa * 10^fs
+        v = col.data.astype(jnp.float64) * (10.0 ** fs)
+        if to.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return Column.fixed(to, v.astype(
+                jnp.float32 if to.id == TypeId.FLOAT32 else jnp.float64),
+                validity=col.validity)
+        iv = col.data.astype(jnp.int64)
+        valid2 = col.valid_mask()
+        if fs >= 0:
+            mul = jnp.int64(10 ** fs)
+            out = iv * mul
+            valid2 = valid2 & ((out // mul) == iv)  # upscale overflow -> null
+        else:
+            q = jnp.int64(10 ** (-fs))
+            out = jnp.where(iv >= 0, iv // q, -((-iv) // q))  # trunc to 0
+        return cast(Column.fixed(DType(TypeId.INT64), out,
+                                 validity=valid2), to)
+    width_max = jnp.int64(2**31 - 1) if to.id == TypeId.DECIMAL32 \
+        else jnp.int64(2**62)
+    if not f.is_decimal:
+        # numeric -> decimal: mantissa = value * 10^-ts (HALF_UP), null on
+        # target-width overflow (Spark non-ANSI overflow-to-null)
+        if f.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            v = col.float_values().astype(jnp.float64)
+            scaled = v * (10.0 ** (-ts))
+            # HALF_UP (away from zero), matching _div_half_up and Spark
+            m = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
+                          jnp.ceil(scaled - 0.5))
+            ok = jnp.isfinite(v) & (jnp.abs(m) <= width_max.astype(
+                jnp.float64))
+            return Column.fixed(
+                to, jnp.where(ok, m, 0.0).astype(jnp.int64).astype(
+                    jnp.dtype(to.storage)),
+                validity=valid & ok)
+        iv = _num_values(col).astype(jnp.int64)
+        if ts <= 0:
+            mul = jnp.int64(10 ** (-ts))
+            m = iv * mul
+            ok = ((m // mul) == iv) & (jnp.abs(m) <= width_max)
+            return Column.fixed(to, m.astype(jnp.dtype(to.storage)),
+                                validity=valid & ok)
+        q = jnp.int64(10 ** ts)
+        m = _div_half_up(iv, q)  # Spark rounds HALF_UP to coarser scales
+        ok = jnp.abs(m) <= width_max
+        return Column.fixed(to, m.astype(jnp.dtype(to.storage)),
+                            validity=valid & ok)
+    # decimal -> decimal rescale
+    diff = fs - ts
+    iv = col.data.astype(jnp.int64)
+    if diff >= 0:
+        mul = jnp.int64(10 ** diff)
+        m = iv * mul
+        ok = (m // mul) == iv
+    else:
+        m = _div_half_up(iv, jnp.int64(10 ** (-diff)))
+        ok = jnp.ones(m.shape, jnp.bool_)  # rounding, not exactness
+    width_ok = jnp.abs(m) <= width_max
+    return Column.fixed(to, m.astype(jnp.dtype(to.storage)),
+                        validity=valid & ok & width_ok)

@@ -277,3 +277,125 @@ def test_local_to_utc_post_2037():
         got = np.asarray(local_to_utc(col, zone).data)[0]
         want = int(datetime(*s, tzinfo=z).timestamp() * 1_000_000)
         assert got == want, s
+
+
+# ---------------------------------------------------------------------------
+# general cast (the cudf::cast role)
+
+
+class TestCast:
+    def _c(self, vals, dtype=None, valid=None):
+        import numpy as _np
+        return Column.from_numpy(_np.asarray(vals),
+                                 validity=None if valid is None
+                                 else _np.asarray(valid, bool), dtype=dtype)
+
+    def test_int_narrowing_wraps(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = self._c(np.array([0, 127, 128, 300, -129, 2**40 + 5], np.int64))
+        out = cast(c, dt.INT8)
+        # Java two's-complement narrowing
+        assert out.to_pylist() == [0, 127, -128, 44, 127,
+                                   int(np.int64(2**40 + 5).astype(np.int8))]
+        out32 = cast(c, dt.INT32)
+        assert out32.to_pylist() == [int(np.int64(v).astype(np.int32))
+                                     for v in [0, 127, 128, 300, -129,
+                                               2**40 + 5]]
+
+    def test_float_to_int_jvm_semantics(self):
+        from spark_rapids_jni_tpu.ops import cast
+        nan, inf = float("nan"), float("inf")
+        c = self._c(np.array([3.9, -3.9, nan, inf, -inf, 1e30]))
+        out = cast(c, dt.INT32)
+        assert out.to_pylist() == [3, -3, 0, 2**31 - 1, -2**31, 2**31 - 1]
+        out64 = cast(c, dt.INT64)
+        got = out64.to_pylist()
+        assert got[:3] == [3, -3, 0]
+        assert got[3] > 2**62 and got[4] < -2**62
+
+    def test_numeric_bool_float(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = self._c(np.array([0, 2, -1], np.int64), valid=[1, 1, 0])
+        assert cast(c, dt.BOOL8).to_pylist() == [False, True, None]
+        f = cast(c, dt.FLOAT64)
+        assert f.to_pylist()[:2] == [0.0, 2.0]
+        b = self._c(np.array([True, False]))
+        assert cast(b, dt.INT32).to_pylist() == [1, 0]
+
+    def test_timestamp_rescale(self):
+        from spark_rapids_jni_tpu.ops import cast
+        ms = Column.fixed(dt.TIMESTAMP_MILLISECONDS,
+                          np.array([1500, -1500, 0], np.int64))
+        us = cast(ms, dt.TIMESTAMP_MICROSECONDS)
+        assert us.to_pylist() == [1_500_000, -1_500_000, 0]
+        s = cast(ms, dt.TIMESTAMP_SECONDS)
+        assert s.to_pylist() == [1, -2, 0]  # floor toward -inf
+        d = cast(ms, dt.TIMESTAMP_DAYS)
+        assert d.to_pylist() == [0, -1, 0]
+
+    def test_decimal_rescale_half_up(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.fixed(dt.decimal64(-4), np.array([12345, -12345, 12350],
+                                                    np.int64))
+        out = cast(c, dt.decimal64(-2))  # 1.2345 -> 1.23 (HALF_UP on .45?)
+        assert out.dtype == dt.decimal64(-2)
+        from decimal import Decimal
+        # mantissa 12345/100 = 123.45 -> 123 (HALF_UP of .45 stays);
+        # 12350 -> 124 (.50 rounds away from zero)
+        assert out.to_pylist() == [Decimal("1.23"), Decimal("-1.23"),
+                                   Decimal("1.24")]
+        wide = cast(out, dt.decimal64(-4))
+        assert wide.to_pylist() == [Decimal("1.2300"), Decimal("-1.2300"),
+                                    Decimal("1.2400")]
+
+    def test_int_decimal_roundtrip(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = self._c(np.array([7, -3, 0], np.int64))
+        d2 = cast(c, dt.decimal64(-2))
+        from decimal import Decimal
+        assert d2.to_pylist() == [Decimal("7.00"), Decimal("-3.00"),
+                                  Decimal("0.00")]
+        back = cast(d2, dt.INT64)
+        assert back.to_pylist() == [7, -3, 0]
+
+    def test_string_delegation(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.from_pylist(["12", "-7", "x", None])
+        out = cast(c, dt.INT64)
+        assert out.to_pylist() == [12, -7, None, None]
+        i = self._c(np.array([42, -5], np.int64))
+        assert cast(i, dt.STRING).to_pylist() == ["42", "-5"]
+
+    def test_float_to_int64_saturates_exactly(self):
+        """r4 review: float(int64.max) rounds to 2**63 so a clip+astype
+        wrapped to int64.min; saturation must hit the exact JVM bounds."""
+        from spark_rapids_jni_tpu.ops import cast
+        inf = float("inf")
+        c = self._c(np.array([9.3e18, inf, -9.3e18, -inf, 1.0]))
+        out = cast(c, dt.INT64)
+        assert out.to_pylist() == [2**63 - 1, 2**63 - 1, -2**63, -2**63, 1]
+
+    def test_numeric_to_decimal_overflow_is_null(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = self._c(np.array([10**10, 5], np.int64))
+        out = cast(c, dt.decimal32(0))
+        from decimal import Decimal
+        assert out.to_pylist() == [None, Decimal(5)]
+        f = self._c(np.array([1e10, 2.0]))
+        out = cast(f, dt.decimal32(0))
+        assert out.to_pylist() == [None, Decimal(2)]
+
+    def test_float_to_decimal_half_up(self):
+        from spark_rapids_jni_tpu.ops import cast
+        from decimal import Decimal
+        c = self._c(np.array([0.125, -0.125, 0.135]))
+        out = cast(c, dt.decimal64(-2))
+        # 0.125 is exactly representable; Spark HALF_UP gives 0.13
+        assert out.to_pylist() == [Decimal("0.13"), Decimal("-0.13"),
+                                   Decimal("0.14")]
+
+    def test_decimal_upscale_to_int_overflow_null(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.fixed(dt.decimal64(6), np.array([10**13, 3], np.int64))
+        out = cast(c, dt.INT64)
+        assert out.to_pylist() == [None, 3 * 10**6]
