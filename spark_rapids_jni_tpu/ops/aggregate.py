@@ -610,31 +610,60 @@ def _groupby_compiled(table: Table, key_names: tuple, aggs: tuple):
     return key_cols, out_aggs, ngroups
 
 
+def _host_key_segments(table: Table, key_names: list):
+    """(order, bounds) of the host-side key lexsort.
+
+    The alignment contract both ragged-agg wrappers rely on: the base
+    groupby's group order is ascending in the encoded key words, and so is
+    this lexsort — group i of the base is segment i here.  ``bounds[j]``
+    marks the first sorted row of each group."""
+    key_cols = [table.column(k) for k in key_names]
+    words = [np.asarray(w) for w in
+             encode_keys([SortKey(c) for c in key_cols])]
+    order = np.lexsort(tuple(reversed(words)))
+    n = len(order)
+    bounds = np.ones(n, np.bool_)
+    if n:
+        bounds[1:] = np.zeros(n - 1, np.bool_)
+        for w in words:
+            sw = w[order]
+            bounds[1:] |= sw[1:] != sw[:-1]
+    return order, bounds
+
+
+def _assemble_special_aggs(base: Table, nkeys: int, aggs: list,
+                           names: list | None, is_special, build) -> Table:
+    """Interleave base scalar-agg columns with specially-built columns in
+    the caller's agg order (shared epilogue of the ragged-agg wrappers)."""
+    out_cols = list(base.columns[:nkeys])
+    oi = nkeys
+    for ref, op in aggs:
+        if is_special(op):
+            out_cols.append(build(ref))
+        else:
+            out_cols.append(base.columns[oi])
+            oi += 1
+    agg_names = names or [
+        f"{op}_{ref if isinstance(ref, str) else i}"
+        for i, (ref, op) in enumerate(aggs)]
+    return Table(out_cols, list(base.names[:nkeys]) + list(agg_names))
+
+
 def _groupby_with_collect(table: Table, key_names: list, aggs: list,
                           names: list | None) -> Table:
     """groupby with collect_list aggs: ragged output, host-compacted.
 
     Scalar aggs run through the normal device path; the list columns are
-    built host-side over the same sorted-key segmentation, so group order
-    matches (both orders are ascending in the encoded key words).  Spark
-    semantics: null elements are dropped; empty groups give [] not null.
+    built host-side over the same sorted-key segmentation
+    (_host_key_segments), so group order matches.  Spark semantics: null
+    elements are dropped; empty groups give [] not null.
     """
     others = [(r, op) for r, op in aggs if op != "collect_list"]
     base = groupby(table, key_names, others) if others else \
         groupby(table, key_names, [(key_names[0], "count_all")])
     nkeys = len(key_names)
-
-    key_cols = [table.column(k) for k in key_names]
-    words = [np.asarray(w) for w in
-             encode_keys([SortKey(c) for c in key_cols])]
-    order = np.lexsort(tuple(reversed(words)))
-    sw = [w[order] for w in words]
+    order, bounds = _host_key_segments(table, key_names)
     n = len(order)
-    bounds = np.ones(n, np.bool_)
-    if n:
-        bounds[1:] = np.zeros(n - 1, np.bool_)
-        for w in sw:
-            bounds[1:] |= w[1:] != w[:-1]
     starts = np.flatnonzero(bounds)
 
     def collect(ref) -> Column:
@@ -660,18 +689,56 @@ def _groupby_with_collect(table: Table, key_names: list, aggs: list,
             raise ValueError("collect_list output exceeds int32 offsets")
         return Column.list_(child, offsets.astype(np.int32))
 
-    out_cols = list(base.columns[:nkeys])
-    oi = nkeys
-    for ref, op in aggs:
-        if op == "collect_list":
-            out_cols.append(collect(ref))
-        else:
-            out_cols.append(base.columns[oi])
-            oi += 1
-    agg_names = names or [
-        f"{op}_{ref if isinstance(ref, str) else i}"
-        for i, (ref, op) in enumerate(aggs)]
-    return Table(out_cols, list(base.names[:nkeys]) + list(agg_names))
+    return _assemble_special_aggs(base, nkeys, aggs, names,
+                                  lambda op: op == "collect_list", collect)
+
+
+def _groupby_with_nunique(table: Table, key_names: list, aggs: list,
+                          names: list | None) -> Table:
+    """groupby with count(DISTINCT col) aggs (Spark nunique).
+
+    Alignment via _host_key_segments: a lexsort over (keys, value)
+    segments identically to the base groupby's group order, so each
+    group's distinct-valid-value count lands at its base row.  Spark
+    semantics: null values are not counted; an all-null group counts 0.
+    """
+    others = [(r, op) for r, op in aggs
+              if op not in ("nunique", "count_distinct")]
+    base = groupby(table, key_names, others) if others else \
+        groupby(table, key_names, [(key_names[0], "count_all")])
+    nkeys = len(key_names)
+    ngroups = base.num_rows
+
+    def nunique(ref) -> Column:
+        col = table.column(ref)
+        # segment by (keys, value): reuse the shared lexsort with the
+        # value column appended as a trailing key
+        aug = Table(list(table.columns) + [col],
+                    list(table.names or range(table.num_columns))
+                    + ["__nunique_v"])
+        order, pb = _host_key_segments(aug, list(key_names)
+                                       + ["__nunique_v"])
+        n = len(order)
+        if n == 0:
+            return Column.fixed(INT64, np.zeros(0, np.int64))
+        # group boundaries under the SAME (keys, value) order: keys-only
+        # word changes
+        kwords = [np.asarray(w) for w in encode_keys(
+            [SortKey(table.column(k)) for k in key_names])]
+        kb = np.ones(n, np.bool_)
+        kb[1:] = False
+        for w in kwords:
+            sw = w[order]
+            kb[1:] |= sw[1:] != sw[:-1]
+        gid = np.cumsum(kb) - 1
+        valid = col.validity_numpy()[order]
+        take = pb & valid  # first row of each distinct non-null value
+        cnt = np.bincount(gid[take], minlength=ngroups).astype(np.int64)
+        return Column.fixed(INT64, cnt)
+
+    return _assemble_special_aggs(
+        base, nkeys, aggs, names,
+        lambda op: op in ("nunique", "count_distinct"), nunique)
 
 
 @traced("groupby")
@@ -680,16 +747,20 @@ def groupby(table: Table, key_names: list, aggs: list[tuple],
     """GROUP BY key_names with aggregations [(column, op), ...] -> compact Table.
 
     op in {sum, min, max, mean, count, count_all, var, std, sumsq, fsum,
-    first, last, collect_list} (the AGGS tuple).  var/std are sample
-    (ddof=1) moments; first/last follow Spark's ignoreNulls=False
-    positional semantics; collect_list drops null elements and returns a
-    LIST column (host-compacted — ragged output can't stay padded).
+    first, last, collect_list} (the AGGS tuple) plus nunique /
+    count_distinct (Spark count(DISTINCT col): null values not counted).
+    var/std are sample (ddof=1) moments; first/last follow Spark's
+    ignoreNulls=False positional semantics; collect_list drops null
+    elements and returns a LIST column (host-compacted — ragged output
+    can't stay padded).
     """
     # One compiled program instead of eager per-op dispatch: on remote
     # devices each eager op costs a full round trip, which turned this host
     # wrapper into minutes of latency.  Jit requires hashable static specs
     # and fixed-width columns (string keys size their padded matrices on
     # the host).
+    if any(op in ("nunique", "count_distinct") for _, op in aggs):
+        return _groupby_with_nunique(table, key_names, aggs, names)
     if any(op == "collect_list" for _, op in aggs):
         return _groupby_with_collect(table, key_names, aggs, names)
     jitable = all(isinstance(k, str) for k in key_names) and \

@@ -79,13 +79,17 @@ def cast(col: Column, to: DType, ansi: bool = False) -> Column:
     # ---- timestamps
     if f.is_timestamp and to.is_timestamp:
         if TypeId.TIMESTAMP_DAYS in (f.id, to.id):
-            day_ns = 86_400 * 10**9
+            # per-unit day length, with NO nanosecond intermediate: a ns
+            # intermediate wraps int64 outside ~1677..2262 while the
+            # day/second/ms/us ranges themselves are fine
             if f.id == TypeId.TIMESTAMP_DAYS:
-                ns = col.data.astype(jnp.int64) * day_ns
-                out = ns // _TS_UNIT[to.id]
+                day_units = 86_400 * (10**9 // _TS_UNIT[to.id])
+                out = col.data.astype(jnp.int64) * jnp.int64(day_units)
             else:
-                ns = col.data.astype(jnp.int64) * _TS_UNIT[f.id]
-                out = jnp.floor_divide(ns, day_ns).astype(jnp.int32)
+                day_units = 86_400 * (10**9 // _TS_UNIT[f.id])
+                out = jnp.floor_divide(
+                    col.data.astype(jnp.int64),
+                    jnp.int64(day_units)).astype(jnp.int32)
             return Column.fixed(to, out, validity=col.validity)
         uf, ut = _TS_UNIT[f.id], _TS_UNIT[to.id]
         v = col.data.astype(jnp.int64)
@@ -112,20 +116,23 @@ def cast(col: Column, to: DType, ansi: bool = False) -> Column:
             info = jnp.iinfo(tdt)
             # JVM double->integral: NaN -> 0, truncate toward zero,
             # out-of-range saturates EXACTLY to min/max.  float(info.max)
-            # rounds up to 2**63 for 64-bit targets (astype would wrap),
-            # so saturate with explicit selects on safely-representable
-            # bounds before the convert.
+            # rounds up to 2**(bits-?) for 64-bit targets (astype would
+            # wrap), so saturate with explicit selects on
+            # safely-representable bounds before the convert.
             t = jnp.where(jnp.isnan(v), 0.0, jnp.trunc(v))
-            hi = float(np.nextafter(np.float64(info.max), 0.0)) \
-                if tdt.itemsize == 8 else float(info.max)
+            # for 64-bit targets float(info.max) rounds UP to the exact
+            # power of two (2**63 signed, 2**64 unsigned): a clean edge
+            edge = float(info.max)
+            hi = float(np.nextafter(np.float64(edge), 0.0)) \
+                if tdt.itemsize == 8 else edge
             lo = float(info.min)
-            over = t >= float(info.max) if tdt.itemsize == 8 \
-                else t > float(info.max)
+            over = t >= edge if tdt.itemsize == 8 else t > edge
             under = t < lo
-            safe = jnp.clip(t, lo, hi).astype(jnp.int64)
-            out = jnp.where(over, jnp.int64(info.max),
-                            jnp.where(under, jnp.int64(info.min), safe))
-            return Column.fixed(to, out.astype(tdt), validity=col.validity)
+            safe = jnp.clip(t, lo, hi).astype(tdt)
+            out = jnp.where(over, jnp.array(info.max, tdt),
+                            jnp.where(under, jnp.array(info.min, tdt),
+                                      safe))
+            return Column.fixed(to, out, validity=col.validity)
         v = _num_values(col)
         # two's-complement narrowing (Java semantics): wrap via the
         # unsigned view of the target width
@@ -157,23 +164,22 @@ def _cast_decimal(col: Column, to: DType) -> Column:
     ts = to.scale if to.is_decimal else 0
     valid = col.valid_mask()
     if f.is_decimal and not to.is_decimal:
-        # decimal -> numeric: value = mantissa * 10^fs
-        v = col.data.astype(jnp.float64) * (10.0 ** fs)
         if to.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            # decimal -> float: value = mantissa * 10^fs
+            v = col.data.astype(jnp.float64) * (10.0 ** fs)
             return Column.fixed(to, v.astype(
                 jnp.float32 if to.id == TypeId.FLOAT32 else jnp.float64),
                 validity=col.validity)
         iv = col.data.astype(jnp.int64)
-        valid2 = col.valid_mask()
         if fs >= 0:
             mul = jnp.int64(10 ** fs)
             out = iv * mul
-            valid2 = valid2 & ((out // mul) == iv)  # upscale overflow -> null
+            valid = valid & ((out // mul) == iv)  # upscale overflow -> null
         else:
             q = jnp.int64(10 ** (-fs))
             out = jnp.where(iv >= 0, iv // q, -((-iv) // q))  # trunc to 0
         return cast(Column.fixed(DType(TypeId.INT64), out,
-                                 validity=valid2), to)
+                                 validity=valid), to)
     width_max = jnp.int64(2**31 - 1) if to.id == TypeId.DECIMAL32 \
         else jnp.int64(2**62)
     if not f.is_decimal:

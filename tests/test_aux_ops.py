@@ -399,3 +399,22 @@ class TestCast:
         c = Column.fixed(dt.decimal64(6), np.array([10**13, 3], np.int64))
         out = cast(c, dt.INT64)
         assert out.to_pylist() == [None, 3 * 10**6]
+
+    def test_timestamp_far_dates_no_ns_overflow(self):
+        """r4 review: a nanosecond intermediate wrapped int64 outside
+        ~1677..2262; day<->unit casts must survive year 9999."""
+        from spark_rapids_jni_tpu.ops import cast
+        days = Column.fixed(dt.TIMESTAMP_DAYS,
+                            np.array([2_930_585], np.int32))  # 9999-12-31
+        us = cast(days, dt.TIMESTAMP_MICROSECONDS)
+        assert us.to_pylist() == [2_930_585 * 86_400 * 10**6]
+        s = Column.fixed(dt.TIMESTAMP_SECONDS,
+                         np.array([16_725_225_600], np.int64))  # ~2500
+        d = cast(s, dt.TIMESTAMP_DAYS)
+        assert d.to_pylist() == [16_725_225_600 // 86_400]
+
+    def test_float_to_uint64(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = self._c(np.array([1.5, -3.0, 2e19, float("inf"), float("nan")]))
+        out = cast(c, dt.UINT64)
+        assert out.to_pylist() == [1, 0, 2**64 - 1, 2**64 - 1, 0]

@@ -608,3 +608,35 @@ def test_outer_joins_with_empty_side():
     rs = Table.from_pydict({"k": ["a", "b"], "rv": [1, 2]})
     out = right_join(es, rs, ["k"])
     assert _rows(out) == [("a", None, 1), ("b", None, 2)]
+
+
+def test_groupby_nunique_matches_pandas():
+    """count(DISTINCT col): nulls not counted, all-null groups count 0,
+    mixes with scalar aggs in one call."""
+    import pandas as pd
+    from spark_rapids_jni_tpu.ops.aggregate import groupby
+    rng = np.random.default_rng(41)
+    n = 500
+    k = rng.integers(0, 9, n)
+    v = rng.integers(0, 12, n).astype(np.int64)
+    ok = rng.random(n) > 0.3
+    ok[k == 3] = False  # one all-null group
+    t = Table([Column.from_numpy(k.astype(np.int64)),
+               Column.from_numpy(v, validity=ok)], ["k", "v"])
+    out = groupby(t, ["k"], [("v", "nunique"), ("v", "count")],
+                  names=["nd", "cnt"])
+    df = pd.DataFrame({"k": k, "v": np.where(ok, v.astype(float), np.nan)})
+    want = df.groupby("k").v.agg(["nunique", "count"])
+    got = dict(zip(out["k"].to_pylist(),
+                   zip(out["nd"].to_pylist(), out["cnt"].to_pylist())))
+    for kk, row in want.iterrows():
+        assert got[kk] == (int(row["nunique"]), int(row["count"])), kk
+
+
+def test_groupby_nunique_string_values():
+    from spark_rapids_jni_tpu.ops.aggregate import groupby
+    t = Table([Column.from_pylist([1, 1, 1, 2, 2]),
+               Column.from_pylist(["a", "b", "a", None, "c"])], ["k", "s"])
+    out = groupby(t, ["k"], [("s", "count_distinct")], names=["nd"])
+    got = dict(zip(out["k"].to_pylist(), out["nd"].to_pylist()))
+    assert got == {1: 2, 2: 1}
