@@ -28,6 +28,7 @@ from .selection import (  # noqa: F401
 )
 from .aggregate import groupby  # noqa: F401
 from .cast import cast  # noqa: F401
+from . import datetime  # noqa: F401
 from .join import (  # noqa: F401
     inner_join, left_join, right_join, full_join, cross_join,
     left_semi_join, left_anti_join, sort_merge_join,
@@ -36,6 +37,7 @@ from .binary import (  # noqa: F401
     add, subtract, multiply, true_divide, floor_div, modulo,
     eq, ne, lt, le, gt, ge, eq_null_safe,
     logical_and, logical_or, logical_not, negate, abs_,
+    round_, floor_, ceil_,
     is_null, is_not_null, coalesce,
 )
 from .window import window  # noqa: F401

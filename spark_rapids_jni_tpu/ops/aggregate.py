@@ -610,25 +610,35 @@ def _groupby_compiled(table: Table, key_names: tuple, aggs: tuple):
     return key_cols, out_aggs, ngroups
 
 
-def _host_key_segments(table: Table, key_names: list):
-    """(order, bounds) of the host-side key lexsort.
+def _host_key_segments(table: Table, key_names: list, value_col=None):
+    """(order, key_bounds, pair_bounds) of the host-side key lexsort.
 
-    The alignment contract both ragged-agg wrappers rely on: the base
+    The alignment contract the ragged-agg wrappers rely on: the base
     groupby's group order is ascending in the encoded key words, and so is
-    this lexsort — group i of the base is segment i here.  ``bounds[j]``
-    marks the first sorted row of each group."""
+    this lexsort — group i of the base is segment i here.  ``key_bounds``
+    marks each group's first sorted row; with ``value_col`` the sort is
+    over (keys, value) and ``pair_bounds`` additionally marks each
+    distinct (key, value) run (else None).  Keys encode exactly once."""
     key_cols = [table.column(k) for k in key_names]
-    words = [np.asarray(w) for w in
-             encode_keys([SortKey(c) for c in key_cols])]
-    order = np.lexsort(tuple(reversed(words)))
+    kwords = [np.asarray(w) for w in
+              encode_keys([SortKey(c) for c in key_cols])]
+    vwords = [] if value_col is None else \
+        [np.asarray(w) for w in encode_keys([SortKey(value_col)])]
+    order = np.lexsort(tuple(reversed(kwords + vwords)))
     n = len(order)
-    bounds = np.ones(n, np.bool_)
-    if n:
-        bounds[1:] = np.zeros(n - 1, np.bool_)
-        for w in words:
-            sw = w[order]
-            bounds[1:] |= sw[1:] != sw[:-1]
-    return order, bounds
+
+    def bounds_of(words):
+        b = np.ones(n, np.bool_)
+        if n:
+            b[1:] = np.zeros(n - 1, np.bool_)
+            for w in words:
+                sw = w[order]
+                b[1:] |= sw[1:] != sw[:-1]
+        return b
+
+    kb = bounds_of(kwords)
+    pb = None if value_col is None else (kb | bounds_of(vwords))
+    return order, kb, pb
 
 
 def _assemble_special_aggs(base: Table, nkeys: int, aggs: list,
@@ -662,7 +672,7 @@ def _groupby_with_collect(table: Table, key_names: list, aggs: list,
     base = groupby(table, key_names, others) if others else \
         groupby(table, key_names, [(key_names[0], "count_all")])
     nkeys = len(key_names)
-    order, bounds = _host_key_segments(table, key_names)
+    order, bounds, _ = _host_key_segments(table, key_names)
     n = len(order)
     starts = np.flatnonzero(bounds)
 
@@ -711,25 +721,9 @@ def _groupby_with_nunique(table: Table, key_names: list, aggs: list,
 
     def nunique(ref) -> Column:
         col = table.column(ref)
-        # segment by (keys, value): reuse the shared lexsort with the
-        # value column appended as a trailing key
-        aug = Table(list(table.columns) + [col],
-                    list(table.names or range(table.num_columns))
-                    + ["__nunique_v"])
-        order, pb = _host_key_segments(aug, list(key_names)
-                                       + ["__nunique_v"])
-        n = len(order)
-        if n == 0:
+        order, kb, pb = _host_key_segments(table, key_names, value_col=col)
+        if len(order) == 0:
             return Column.fixed(INT64, np.zeros(0, np.int64))
-        # group boundaries under the SAME (keys, value) order: keys-only
-        # word changes
-        kwords = [np.asarray(w) for w in encode_keys(
-            [SortKey(table.column(k)) for k in key_names])]
-        kb = np.ones(n, np.bool_)
-        kb[1:] = False
-        for w in kwords:
-            sw = w[order]
-            kb[1:] |= sw[1:] != sw[:-1]
         gid = np.cumsum(kb) - 1
         valid = col.validity_numpy()[order]
         take = pb & valid  # first row of each distinct non-null value

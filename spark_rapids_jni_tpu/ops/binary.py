@@ -237,6 +237,64 @@ def abs_(a: Column) -> Column:
 
 
 @traced("unary_op")
+def round_(a: Column, scale: int = 0) -> Column:
+    """Spark ``round(col, scale)``: HALF_UP (away from zero).
+
+    Floats stay FLOAT64; integral inputs round at negative scales (tens,
+    hundreds, ...) and pass through otherwise.  Integral results that
+    would exceed int64 saturate at the largest representable multiple of
+    the rounding unit; ``scale <= -19`` exceeds int64 entirely and
+    raises."""
+    if a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        v = a.float_values().astype(jnp.float64)
+        p = 10.0 ** scale
+        s = v * p
+        r = jnp.where(s >= 0, jnp.floor(s + 0.5), jnp.ceil(s - 0.5))
+        return Column.fixed(FLOAT64, r / p, validity=a.validity)
+    if scale >= 0:
+        return a
+    if scale <= -19:
+        raise ValueError("round scale <= -19 exceeds the int64 range")
+    q = 10 ** (-scale)
+    v = a.data.astype(jnp.int64)
+    # overflow-free HALF_UP: floor-div + remainder comparison (the
+    # _div_half_up '+ q//2' form wraps at the int64 extremes)
+    qj = jnp.int64(q)
+    b = jnp.floor_divide(v, qj)
+    r = v - b * qj                       # in [0, q)
+    up = jnp.where(v >= 0, 2 * r >= qj, 2 * (qj - r) < qj)
+    m = b + up.astype(jnp.int64)
+    lim = (2**63 - 1) // q
+    out = jnp.clip(m, -lim, lim) * qj
+    return _result(INT64, out, a.validity)
+
+
+def _float_to_long(a: Column, fn) -> Column:
+    from ..dtypes import INT64 as _I64D
+    from .cast import cast
+    v = fn(a.float_values().astype(jnp.float64))
+    # reuse cast()'s saturating double->long rules (NaN -> 0, +/-inf and
+    # out-of-range saturate) instead of a raw astype that wraps
+    return cast(Column.fixed(FLOAT64, v, validity=a.validity), _I64D)
+
+
+@traced("unary_op")
+def floor_(a: Column) -> Column:
+    """Spark ``floor(double) -> long``; integral inputs pass through."""
+    if a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return _float_to_long(a, jnp.floor)
+    return a
+
+
+@traced("unary_op")
+def ceil_(a: Column) -> Column:
+    """Spark ``ceil(double) -> long``; integral inputs pass through."""
+    if a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return _float_to_long(a, jnp.ceil)
+    return a
+
+
+@traced("unary_op")
 def is_null(a: Column) -> Column:
     return Column(BOOL8, data=(~a.valid_mask()).astype(jnp.uint8))
 

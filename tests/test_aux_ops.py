@@ -418,3 +418,74 @@ class TestCast:
         c = self._c(np.array([1.5, -3.0, 2e19, float("inf"), float("nan")]))
         out = cast(c, dt.UINT64)
         assert out.to_pylist() == [1, 0, 2**64 - 1, 2**64 - 1, 0]
+
+
+# ---------------------------------------------------------------------------
+# datetime extraction + round/floor/ceil
+
+
+class TestDatetimeAndRound:
+    def test_civil_extraction_matches_pandas(self):
+        import pandas as pd
+        from spark_rapids_jni_tpu.ops import datetime as dtm
+        rng = np.random.default_rng(3)
+        us = rng.integers(-60 * 10**15, 60 * 10**15, 3_000)  # ~1968..3871
+        c = Column.fixed(dt.TIMESTAMP_MICROSECONDS, us)
+        ts = pd.to_datetime(us, unit="us", utc=True)
+        assert dtm.year(c).to_pylist() == ts.year.tolist()
+        assert dtm.month(c).to_pylist() == ts.month.tolist()
+        assert dtm.dayofmonth(c).to_pylist() == ts.day.tolist()
+        assert dtm.hour(c).to_pylist() == ts.hour.tolist()
+        assert dtm.minute(c).to_pylist() == ts.minute.tolist()
+        assert dtm.second(c).to_pylist() == ts.second.tolist()
+        assert dtm.dayofyear(c).to_pylist() == ts.dayofyear.tolist()
+        assert dtm.quarter(c).to_pylist() == ts.quarter.tolist()
+        # Spark dayofweek: 1=Sunday; pandas: Monday=0
+        assert dtm.dayofweek(c).to_pylist() == \
+            [(d + 2) % 7 or 7 for d in ts.dayofweek.tolist()]
+
+    def test_date_columns_and_last_day(self):
+        import pandas as pd
+        from spark_rapids_jni_tpu.ops import datetime as dtm
+        days = np.array([0, 58, 59, 789, -1, 19000], np.int32)  # incl. leap
+        c = Column.fixed(dt.TIMESTAMP_DAYS, days)
+        ts = pd.to_datetime(days.astype(np.int64), unit="D", utc=True)
+        assert dtm.year(c).to_pylist() == ts.year.tolist()
+        assert dtm.month(c).to_pylist() == ts.month.tolist()
+        ld = dtm.last_day(c)
+        want = [(t + pd.offsets.MonthEnd(0)).normalize() for t in ts]
+        got = pd.to_datetime(np.asarray(ld.data).astype(np.int64),
+                             unit="D", utc=True)
+        assert list(got) == [w for w in want]
+        with pytest.raises(TypeError):
+            dtm.hour(c)  # DATE has no time component
+
+    def test_round_floor_ceil(self):
+        from spark_rapids_jni_tpu.ops import round_, floor_, ceil_
+        f = Column.from_numpy(np.array([2.5, -2.5, 1.25, -1.35, 3.0]))
+        assert round_(f).to_pylist() == [3.0, -3.0, 1.0, -1.0, 3.0]
+        assert round_(f, 1).to_pylist() == [2.5, -2.5, 1.3, -1.4, 3.0]
+        assert floor_(f).to_pylist() == [2, -3, 1, -2, 3]
+        assert ceil_(f).to_pylist() == [3, -2, 2, -1, 3]
+        i = Column.from_numpy(np.array([1234, -1251], np.int64))
+        assert round_(i, -2).to_pylist() == [1200, -1300]
+        assert round_(i).to_pylist() == [1234, -1251]
+
+    def test_floor_ceil_special_values_saturate(self):
+        """r4 review: raw astype wrapped NaN/inf/1e19; Spark double->long
+        rules must apply (NaN->0, saturation)."""
+        from spark_rapids_jni_tpu.ops import floor_, ceil_
+        nan, inf = float("nan"), float("inf")
+        f = Column.from_numpy(np.array([nan, inf, -inf, 1e19, -1e19]))
+        for op in (floor_, ceil_):
+            assert op(f).to_pylist() == [0, 2**63 - 1, -2**63,
+                                         2**63 - 1, -2**63]
+
+    def test_round_negative_scale_guards(self):
+        from spark_rapids_jni_tpu.ops import round_
+        big = Column.from_numpy(np.array([2**63 - 1, -(2**63 - 1)], np.int64))
+        out = round_(big, -2)
+        lim = (2**63 - 1) // 100 * 100
+        assert out.to_pylist() == [lim, -lim]  # saturated multiple
+        with pytest.raises(ValueError):
+            round_(big, -19)
