@@ -1,5 +1,6 @@
 from .column import Column, PackedByteColumn
 from .table import Table
-from .arrow import from_arrow, to_arrow
+from .arrow import from_arrow, to_arrow, from_pandas, to_pandas
 
-__all__ = ["Column", "PackedByteColumn", "Table", "from_arrow", "to_arrow"]
+__all__ = ["Column", "PackedByteColumn", "Table", "from_arrow", "to_arrow",
+           "from_pandas", "to_pandas"]

@@ -163,3 +163,16 @@ def to_arrow(table: Table):
     import pyarrow as pa
     names = list(table.names or [f"c{i}" for i in range(table.num_columns)])
     return pa.table([to_arrow_column(c) for c in table.columns], names=names)
+
+
+def from_pandas(df) -> Table:
+    """pandas.DataFrame -> device Table (via the Arrow interop: pandas'
+    own Arrow conversion handles dtype/null-mask normalization)."""
+    import pyarrow as pa
+    return from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+
+def to_pandas(table: Table):
+    """Device Table -> pandas.DataFrame (via Arrow; nulls become
+    NaN/None per pandas' usual Arrow conversion)."""
+    return to_arrow(table).to_pandas()

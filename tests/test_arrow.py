@@ -85,3 +85,23 @@ def test_decimal_buffer_ingest_large():
     t = pa.table({"d": pa.array(vals, pa.decimal128(12, 2))})
     dev = from_arrow(t)
     assert dev["d"].to_pylist() == vals
+
+
+def test_pandas_roundtrip():
+    import pandas as pd
+    from spark_rapids_jni_tpu.columnar import from_pandas, to_pandas
+    df = pd.DataFrame({
+        "i": pd.array([1, None, 3], dtype="Int64"),
+        "f": [1.5, float("nan"), -2.0],
+        "s": ["a", None, "ccc"],
+        "b": pd.array([True, False, None], dtype="boolean"),
+    })
+    t = from_pandas(df)
+    assert t["i"].to_pylist() == [1, None, 3]
+    assert t["s"].to_pylist() == ["a", None, "ccc"]
+    assert t["b"].to_pylist() == [True, False, None]
+    back = to_pandas(t)
+    assert back["i"].tolist()[0] == 1
+    # pandas renders string nulls as NaN in object columns
+    assert back["s"].isna().tolist() == [False, True, False]
+    assert back["s"].tolist()[0] == "a" and back["s"].tolist()[2] == "ccc"
