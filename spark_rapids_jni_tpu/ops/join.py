@@ -76,27 +76,47 @@ def _pair_equal(lcol: Column, rcol: Column, li, ri, null_equal: bool):
     return eq
 
 
-def _rank_bounds(ref, queries) -> tuple[jnp.ndarray, jnp.ndarray]:
+_TAG = jnp.int64(1) << 32  # packs (tie tag, unsort index) into ONE operand
+
+
+def _rank_bounds(ref, queries, ref_sorted=None) \
+        -> tuple[jnp.ndarray, jnp.ndarray]:
     """(lo, hi) ranks: count of ``ref`` elements < / <= each query.
 
     The searchsorted replacement: TPU binary search serializes into ~20
     rounds of slow gathers (docs/PERF.md); a merge-rank is one sort of
-    [refs, lo-copies, hi-copies] + cumsum + one unsort.  The tie tag
-    decides < vs <=: a lo-copy sorts before equal refs, a hi-copy after.
-    ``ref`` need not be sorted.
+    [queries, refs] + cumsum + one unsort.  Queries are NOT duplicated and
+    both sorts carry exactly two operands: the tie tag and the unsort index
+    share one packed int64 (tag in bit 32 — a query sorts before equal
+    refs, so the ref prefix-count at a query position is its strict rank
+    ``lo``).  ``hi`` then comes from equal-run lengths of the sorted refs
+    (reverse-cummin run ends + two gathers), not a second merged sort.
+    ``ref`` need not be sorted; pass ``ref_sorted`` if the caller already
+    sorted it (``_probe_ranges`` shares its build-side sort).
     """
     nq, nr = queries.shape[0], ref.shape[0]
-    vals = jnp.concatenate([queries, ref, queries])
-    tags = jnp.concatenate([jnp.zeros((nq,), jnp.int32),       # lo copies
-                            jnp.ones((nr,), jnp.int32),        # refs
-                            jnp.full((nq,), 2, jnp.int32)])    # hi copies
-    orig = jnp.concatenate([jnp.arange(nq, dtype=jnp.int32),
-                            jnp.full((nr,), 2 * nq, jnp.int32),
-                            jnp.arange(nq, 2 * nq, dtype=jnp.int32)])
-    _, st, so = jax.lax.sort((vals, tags, orig), num_keys=2, is_stable=True)
-    crs = jnp.cumsum((st == 1).astype(jnp.int32))  # refs at or before
-    _, rank_q = jax.lax.sort((so, crs), num_keys=1, is_stable=True)
-    return rank_q[:nq], rank_q[nq:2 * nq]
+    vals = jnp.concatenate([queries, ref])
+    c = jnp.concatenate([jnp.arange(nq, dtype=jnp.int64),
+                         _TAG + jnp.arange(nr, dtype=jnp.int64)])
+    _, sc = jax.lax.sort((vals, c), num_keys=2, is_stable=False)
+    isref = sc >= _TAG
+    crs = jnp.cumsum(isref.astype(jnp.int32))
+    _, rank_q = jax.lax.sort((sc, crs), num_keys=1, is_stable=False)
+    lo = rank_q[:nq]
+
+    srt = jnp.sort(ref) if ref_sorted is None else ref_sorted
+    idx = jnp.arange(nr, dtype=jnp.int32)
+    if nr:
+        is_last = jnp.concatenate([srt[1:] != srt[:-1],
+                                   jnp.ones((1,), jnp.bool_)])
+        run_end = jnp.flip(jax.lax.cummin(
+            jnp.flip(jnp.where(is_last, idx, jnp.int32(nr)))))
+        p = jnp.clip(lo, 0, nr - 1)
+        match = (lo < nr) & (jnp.take(srt, p) == queries)
+        hi = lo + jnp.where(match, jnp.take(run_end, p) - p + 1, 0)
+    else:
+        hi = lo
+    return lo, hi
 
 
 def _probe_ranges(lh, rh):
@@ -105,11 +125,18 @@ def _probe_ranges(lh, rh):
     Returns (r_order, lo, offsets, starts, expansion) where probe row i's
     candidates occupy sorted positions [lo, hi) recoverable from
     starts/offsets, and ``expansion`` is the total candidate-pair count.
+
+    Ranking runs on the LOW 32 BITS of the hashes: int32 sort keys are
+    markedly cheaper than int64, and a 32-bit collision between distinct
+    64-bit hashes only widens a candidate range — the exact per-pair key
+    verification downstream filters it, same as a full hash collision.
     """
-    r_order = jax.lax.sort(
+    lh = lh.astype(_I32)
+    rh = rh.astype(_I32)
+    rh_sorted, r_order = jax.lax.sort(
         (rh, jnp.arange(rh.shape[0], dtype=_I32)), num_keys=1,
-        is_stable=True)[1]
-    lo, hi = _rank_bounds(rh, lh)
+        is_stable=True)
+    lo, hi = _rank_bounds(rh, lh, ref_sorted=rh_sorted)
     lo, hi = lo.astype(_I32), hi.astype(_I32)
     counts = (hi - lo).astype(jnp.int64)
     offsets = jnp.cumsum(counts)
@@ -133,25 +160,32 @@ def _expand_pairs(r_order, lo, offsets, starts, nl, nr, total):
     if nl == 0:
         z = jnp.zeros((total,), _I32)
         return z, z, jnp.zeros((total,), jnp.bool_)
-    j = jnp.arange(total, dtype=jnp.int64)
+    assert total < 2**31 - 2, "pair capacity exceeds int32 slot ids"
+    # slot ids fit int32 (capacities are way under 2^31); run starts at or
+    # beyond the capacity can't own a slot, so clamping them to the filler
+    # key keeps the int32 range safe even when the true expansion overflows
+    j = jnp.arange(total, dtype=_I32)
     counts = offsets - starts
-    mark_key = jnp.where(counts > 0, starts, jnp.int64(total + 1))
-    keys = jnp.concatenate([mark_key, j])
-    okv = jnp.concatenate([(counts > 0).astype(jnp.uint8),
-                           jnp.zeros((total,), jnp.uint8)])
-    idxs = jnp.concatenate([jnp.arange(nl, dtype=_I32),
-                            jnp.full((total,), nl, _I32)])
-    k1, o1, i1 = jax.lax.sort((keys, okv, idxs), num_keys=1, is_stable=True)
-    keep = jnp.concatenate([jnp.ones((1,), jnp.bool_), k1[1:] != k1[:-1]])
-    ck = jnp.where(keep, k1, jnp.int64(total + 2))
-    _, o2, i2 = jax.lax.sort((ck, o1, i1), num_keys=1, is_stable=True)
-    okc = o2[:total].astype(jnp.bool_)
-    li = jax.lax.cummax(jnp.where(okc, i2[:total], jnp.int32(-1)))
-    startj = jax.lax.cummax(jnp.where(okc, j.astype(jnp.int64),
-                                      jnp.int64(-1)))
-    in_range = (li >= 0) & (j < (offsets[-1] if nl else 0))
+    # merge run-start markers (probe rows with candidates, at their start
+    # slot) against the slot ids; a run starting at j owns slot j, so
+    # markers tag-sort BEFORE equal slots.  The carried probe-row index is
+    # monotone along sorted markers (starts is strictly increasing over
+    # counts>0 rows), so one cummax forward-fills each slot's owner; the
+    # run start is then a gather of ``starts`` — no second marker sort, no
+    # third operand.
+    mark_key = jnp.where((counts > 0) & (starts <= total), starts,
+                         jnp.int64(total + 1)).astype(_I32)
+    vals = jnp.concatenate([mark_key, j])
+    c = jnp.concatenate([jnp.arange(nl, dtype=jnp.int64),
+                         _TAG + j.astype(jnp.int64)])
+    _, sc = jax.lax.sort((vals, c), num_keys=2, is_stable=False)
+    owner = jax.lax.cummax(jnp.where(sc < _TAG, sc.astype(_I32),
+                                     jnp.int32(-1)))
+    _, own_q = jax.lax.sort((sc, owner), num_keys=1, is_stable=False)
+    li = own_q[nl:]
+    in_range = (li >= 0) & (j < offsets[-1])
     li = jnp.clip(li, 0, max(nl - 1, 0))
-    within = (j - startj).astype(_I32)
+    within = (j - jnp.take(starts, li)).astype(_I32)
     ri_sorted_pos = jnp.clip(jnp.take(lo, li) + within, 0, max(nr - 1, 0))
     ri = jnp.take(r_order, ri_sorted_pos).astype(_I32)
     return li, ri, in_range
@@ -269,8 +303,39 @@ def inner_join_padded(left: Table, right: Table, on_left, on_right,
         iota = jnp.arange(rh.shape[0], dtype=rh.dtype)
         rh = jnp.where(right_live, rh, iota * 2)     # even sentinels
     r_order, lo, offsets, starts, expansion = _probe_ranges(lh, rh)
-    li, ri, in_range = _expand_pairs(r_order, lo, offsets, starts,
-                                     lh.shape[0], rh.shape[0], capacity)
+    nl, nr = lh.shape[0], rh.shape[0]
+    if capacity >= nl:
+        # FK fast path: each probe row's FIRST candidate is a direct pair
+        # (slot i = probe row i — no enumeration sorts), and only the
+        # surplus candidates from duplicate-key runs ride the expansion
+        # machinery, at the leftover capacity.  For unique build keys (the
+        # dominant join shape) the expansion side is structurally empty.
+        counts = offsets - starts
+        iota = jnp.arange(nl, dtype=_I32)
+        ri_d = jnp.take(r_order,
+                        jnp.clip(lo, 0, max(nr - 1, 0)).astype(_I32))
+        dir_ok = counts > 0
+        xcounts = jnp.maximum(counts - 1, 0)
+        xoffsets = jnp.cumsum(xcounts)
+        xstarts = xoffsets - xcounts
+        xcap = capacity - nl
+        if xcap > 0:
+            li_x, ri_x, ok_x = _expand_pairs(
+                r_order, (lo + 1).astype(lo.dtype), xoffsets, xstarts,
+                nl, nr, xcap)
+            li = jnp.concatenate([iota, li_x])
+            ri = jnp.concatenate([ri_d, ri_x])
+            in_range = jnp.concatenate([dir_ok, ok_x])
+        else:
+            li, ri, in_range = iota, ri_d, dir_ok
+        # surplus candidates that didn't fit the extra slots are lost even
+        # when nl-side direct slots sit dead, so overflow counts extras
+        xtotal = xoffsets[-1] if nl else jnp.int64(0)
+        overflow = jnp.maximum(xtotal - xcap, 0)
+    else:
+        li, ri, in_range = _expand_pairs(r_order, lo, offsets, starts,
+                                         nl, nr, capacity)
+        overflow = jnp.maximum(expansion - capacity, 0)
     eq = in_range
     if left_live is not None:
         eq = eq & jnp.take(left_live, li)
@@ -279,8 +344,8 @@ def inner_join_padded(left: Table, right: Table, on_left, on_right,
     for lc, rc in zip(lk.columns, rk.columns):
         eq = eq & _pair_equal(lc, rc, li, ri, null_equal=False)
     # candidate pairs beyond capacity can't be equality-checked at static
-    # shape; overflow is their count (a superset bound on lost true pairs)
-    overflow = jnp.maximum(expansion - capacity, 0)
+    # shape; ``overflow`` (set per path above) is their count — a superset
+    # bound on lost true pairs
     from .selection import nonzero_indices
     order = nonzero_indices(eq, count=capacity)
     npairs = jnp.sum(eq.astype(jnp.int32))

@@ -34,8 +34,8 @@ from ..ops.row_conversion import fixed_width_layout, _build_planes, \
     _from_planes
 from .mesh import ROW_AXIS, axis_size
 from ..utils.tracing import traced
-from .shuffle import (partition_ids, cap_bucket, exchange_planes,
-                      partition_counts)
+from .shuffle import (partition_ids, cap_bucket, cap_bucket_fine,
+                      exchange_planes, partition_counts)
 
 # (partial op emitted by the local pass, final re-aggregation op)
 _REAGG = {"sum": "sum", "count": "sum", "count_all": "sum",
@@ -427,11 +427,21 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
     if auto_cap:
         # two-phase exchange: counts are exact for joins (no pre-agg dedup);
         # each side sized independently (builder takes lcap/rcap)
-        lcap = cap_bucket(int(partition_counts(lt, mesh, lkeys, axis).max()))
-        rcap = cap_bucket(int(partition_counts(rt, mesh, rkeys, axis).max()))
+        lcounts = partition_counts(lt, mesh, lkeys, axis)
+        rcounts = partition_counts(rt, mesh, rkeys, axis)
+        lcap = cap_bucket(int(lcounts.max()))
+        rcap = cap_bucket(int(rcounts.max()))
+        if auto_jcap:
+            # candidate pairs per shard start at (received left + received
+            # right) rows — exact for FK-style joins, and the overflow
+            # retry below right-sizes heavy-duplicate keys.  Fine buckets:
+            # jcap is the largest sort in the program, so 2x pow2 padding
+            # is real work.
+            recv = int(lcounts.sum(axis=0).max() + rcounts.sum(axis=0).max())
+            join_capacity = cap_bucket_fine(recv)
     else:
         lcap = rcap = capacity
-    if auto_jcap:
+    if auto_jcap and join_capacity is None:
         join_capacity = 2 * ndev * max(lcap, rcap)
 
     lnames = tuple(lt.names or [f"l{i}" for i in range(lt.num_columns)])

@@ -49,59 +49,46 @@ def partition_ids(key_table: Table, num_partitions: int) -> jnp.ndarray:
 
 def _bucket_pack_planes(planes, dest: jnp.ndarray, row_mask, ndev: int,
                         capacity: int):
-    """Scatter-free bucket pack: rows into per-destination slots via sorts.
+    """Scatter-free bucket pack: rows into per-destination slots.
 
     Sort-carried rather than scatter-based (docs/PERF.md: TPU scatters
-    serialize; multi-operand sorts don't).  ``planes`` is the
-    word-major row decomposition (nw dense u32[n] vectors — never the
-    lane-padded (n, nw) matrix).  Returns (send_planes [(ndev, capacity)
-    u32 per word], ok (ndev, capacity) bool, overflow scalar).
+    serialize), but the payload planes are never sorted: ONE stable
+    2-operand sort of (dest, row-index) groups the row *indices* by
+    destination, a one-hot reduction counts rows per destination, and the
+    (ndev, capacity) send grid fills by GATHER — slot (d, r) reads sorted
+    position start[d] + r.  Each u32 plane moves exactly once (the gather)
+    instead of riding two (nw+2)-operand sorts of n + ndev*capacity
+    elements, which dominated the exchange cost.
 
-    Slot assignment: pos = running count of earlier same-dest rows (one
-    cumsum per destination, ndev is small and static); slot = dest*cap+pos,
-    unique per row.  Slots materialize by sorting real rows against one
-    filler row per slot (stable, real first), keeping first-per-slot, and
-    compacting with a second sort.
+    ``planes`` is the word-major row decomposition (nw dense u32[n]
+    vectors — never the lane-padded (n, nw) matrix).  Returns (send_planes
+    [(ndev, capacity) u32 per word], ok (ndev, capacity) bool, overflow
+    scalar = live rows that didn't fit their destination bucket).
     """
     n = dest.shape[0]
-    S = ndev * capacity
-    live = None
+    if n == 0:
+        ok = jnp.zeros((ndev, capacity), jnp.bool_)
+        send = [jnp.zeros((ndev, capacity), p.dtype) for p in planes]
+        return send, ok, jnp.int32(0)
     if row_mask is not None:
-        live = row_mask
         dest = jnp.where(row_mask, dest, jnp.int32(ndev))
-    if ndev <= 16:
-        # O(ndev * n) but each pass is one fast cumsum; wins at small meshes
-        pos = jnp.zeros((n,), jnp.int32)
-        for d in range(ndev):
-            hit = dest == d
-            pos = jnp.where(hit, jnp.cumsum(hit.astype(jnp.int32)) - 1, pos)
-    else:
-        # pod-scale: rank within destination via one sort + forward fill,
-        # cost independent of ndev
-        idx = jnp.arange(n, dtype=jnp.int32)
-        sd, si = jax.lax.sort((dest, idx), num_keys=1, is_stable=True)
-        firstm = jnp.concatenate([jnp.ones((1,), jnp.bool_),
-                                  sd[1:] != sd[:-1]])
-        run_start = jax.lax.cummax(jnp.where(firstm, idx, jnp.int32(-1)))
-        spos = idx - run_start
-        _, pos = jax.lax.sort((si, spos), num_keys=1, is_stable=True)
-    in_bounds = (dest < ndev) & (pos < capacity)
-    slot = jnp.where(in_bounds, dest * capacity + pos, jnp.int32(S))
-    nlive = jnp.sum((dest < ndev).astype(jnp.int32)) if live is None else \
-        jnp.sum(live.astype(jnp.int32))
-    overflow = nlive - jnp.sum(in_bounds.astype(jnp.int32))
-
-    keys = jnp.concatenate([slot, jnp.arange(S, dtype=jnp.int32)])
-    okv = jnp.concatenate([in_bounds.astype(jnp.uint8),
-                           jnp.zeros((S,), jnp.uint8)])
-    pls = [jnp.concatenate([p, jnp.zeros((S,), p.dtype)]) for p in planes]
-    s1 = jax.lax.sort((keys, okv) + tuple(pls), num_keys=1, is_stable=True)
-    k1 = s1[0]
-    keep = jnp.concatenate([jnp.ones((1,), jnp.bool_), k1[1:] != k1[:-1]])
-    ckey = jnp.where(keep, k1, jnp.int32(S + 1))
-    s2 = jax.lax.sort((ckey,) + tuple(s1[1:]), num_keys=1, is_stable=True)
-    ok = s2[1][:S].astype(jnp.bool_).reshape(ndev, capacity)
-    send = [p[:S].reshape(ndev, capacity) for p in s2[2:]]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sd, si = jax.lax.sort((dest, idx), num_keys=1, is_stable=True)
+    # rows per destination from the sorted runs: ndev binary-search queries
+    # over sd (ndev-independent in n — a one-hot reduction would be
+    # Theta(ndev*n) at pod scale, a bincount scatter-add would serialize
+    # on TPU)
+    d = jnp.arange(ndev, dtype=jnp.int32)
+    start = jnp.searchsorted(sd, d, side="left").astype(jnp.int32)
+    cnt = jnp.searchsorted(sd, d, side="right").astype(jnp.int32) - start
+    r = jnp.arange(capacity, dtype=jnp.int32)[None, :]
+    src = start[:, None] + r                       # (ndev, capacity)
+    ok = r < jnp.minimum(cnt, capacity)[:, None]
+    rows = jnp.take(si, jnp.clip(src, 0, max(n - 1, 0)).reshape(-1))
+    okf = ok.reshape(-1)
+    send = [jnp.where(okf, jnp.take(p, rows), jnp.zeros((), p.dtype))
+            .reshape(ndev, capacity) for p in planes]
+    overflow = jnp.sum(jnp.maximum(cnt - capacity, 0))
     return send, ok, overflow
 
 
@@ -114,6 +101,24 @@ def cap_bucket(count: int) -> int:
     cap = 32
     while cap < count:
         cap *= 2
+    return cap
+
+
+def cap_bucket_fine(count: int) -> int:
+    """Round up to a quarter-power-of-two bucket (1, 1.25, 1.5, 1.75 x 2^k).
+
+    For the big data-dependent capacities (join pair counts) the 2x
+    worst-case padding of ``cap_bucket`` is real sort work; quarter buckets
+    cap padding waste at 25% for at most 4x the distinct compiled programs.
+    """
+    cap = 32
+    while cap < count:
+        cap *= 2
+    if cap >= 128:
+        for frac in (4, 5, 6, 7):
+            fine = cap // 8 * frac
+            if fine >= count:
+                return fine
     return cap
 
 
