@@ -925,23 +925,41 @@ class ParquetFile:
         return Table([h.to_column() for h in cols],
                      [h.schema.name for h in cols])
 
-    def read(self, columns=None, staged: bool = False) -> Table:
+    def read(self, columns=None, staged: bool | None = None) -> Table:
         """Read into a device Table.
 
-        ``staged=True`` routes fixed-width schemas through ONE packed
-        device transfer + a jitted on-device unpack (io/staging.py).  The
-        unpack compiles once per schema — a loss on a single cold scan
-        through a slow remote-compile tunnel, a win whenever the same
-        schema is scanned repeatedly (the NDS pattern) on an RTT-bound
-        link.  Default is per-column async transfers."""
+        The staged path (ONE packed device transfer + a jitted on-device
+        unpack, io/staging.py — the GDS role) is the DEFAULT scan->device
+        route for fixed-width schemas: ``staged=None`` takes it whenever
+        its unpack program is already compiled for this (schema, row
+        bucket), and otherwise ships per-column now while compiling the
+        staged program on a background thread, so the next scan (the NDS
+        repeated-scan pattern) is single-transfer.  ``staged=True`` forces
+        the staged path (paying a first-touch compile), ``staged=False``
+        forces per-column transfers."""
         idxs = self._column_indices(columns)
-        if (staged and self.num_row_groups >= 1 and
-                all(self.schema[i].dtype is not None and
-                    self.schema[i].dtype.is_fixed_width and
-                    self.schema[i].dtype.id != dt.TypeId.DECIMAL128 and
-                    not self.schema[i].is_list and
-                    not self.schema[i].is_struct for i in idxs)):
-            return self._read_staged(columns)
+        eligible = (self.num_row_groups >= 1 and
+                    all(self.schema[i].dtype is not None and
+                        self.schema[i].dtype.is_fixed_width and
+                        self.schema[i].dtype.id != dt.TypeId.DECIMAL128 and
+                        not self.schema[i].is_list and
+                        not self.schema[i].is_struct for i in idxs))
+        if staged and not eligible:
+            staged = False  # explicit request, ineligible schema
+        if eligible and staged is not False:
+            from .staging import plan_ready, warm_plan_async
+            hosts = self._decode_all_groups(columns)
+            merged = hosts[0] if len(hosts) == 1 else \
+                [_concat_host([g[i] for g in hosts])
+                 for i in range(len(hosts[0]))]
+            specs = [(h.schema.name, h.schema.dtype, h.values, h.validity)
+                     for h in merged]
+            if staged or plan_ready(specs):
+                from .staging import stage_fixed_table
+                return stage_fixed_table(specs)
+            warm_plan_async(specs)  # single-transfer from the next scan on
+            return Table([h.to_column() for h in merged],
+                         [h.schema.name for h in merged])
         hosts = self._decode_all_groups(columns)
         if not hosts:  # valid file, zero row groups (empty partition)
             empty = [_empty_host(self.schema[i])
@@ -955,26 +973,6 @@ class ParquetFile:
                   for i in range(len(hosts[0]))]
         return Table([h.to_column() for h in merged],
                      [h.schema.name for h in merged])
-
-    def _read_staged(self, columns=None) -> Table:
-        """Fixed-width read through ONE staged device transfer.
-
-        The GDS role (reference CMakeLists.txt:176-199 — cuFile exists to
-        keep the storage->device path off the bounce-buffer critical
-        path).  Row groups decode on host threads; all column buffers then
-        pack into one contiguous u32 staging buffer shipped in a single
-        ``device_put`` (io/staging.py) — on RTT-dominated links (tunneled
-        devices: hundreds of ms per dispatch) this beats both per-column
-        puts and per-group pipelining, which r4 measured at 14% of the
-        link rate.
-        """
-        from .staging import stage_fixed_table
-        hosts = self._decode_all_groups(columns)
-        merged = [_concat_host([g[i] for g in hosts])
-                  for i in range(len(hosts[0]))]
-        return stage_fixed_table(
-            [(h.schema.name, h.schema.dtype, h.values, h.validity)
-             for h in merged])
 
     def _decode_all_groups(self, columns=None) -> list:
         """All row groups decoded host-side; >1 group fans out on a thread
@@ -1045,10 +1043,11 @@ def _concat_host(parts: list[_HostColumn]) -> _HostColumn:
                        None, None, valid)
 
 
-def read_parquet(path, columns=None, staged: bool = False) -> Table:
+def read_parquet(path, columns=None, staged: bool | None = None) -> Table:
     """Read a whole parquet file into a device Table.
 
-    ``staged=True``: single packed device transfer + jitted unpack —
+    Fixed-width schemas default to the staged single-transfer path with
+    first-touch fallback (see ParquetFile.read); ``staged=True``: force it —
     see ParquetFile.read."""
     return ParquetFile(path).read(columns, staged=staged)
 

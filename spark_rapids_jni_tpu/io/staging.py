@@ -71,6 +71,73 @@ def _bucket(n: int) -> int:
     return b
 
 
+def _plan_for(specs):
+    """The (plan, total_words) ``stage_fixed_table`` will use for these
+    specs — computed WITHOUT packing, so callers can ask whether the
+    unpack program is already compiled before paying for the pack."""
+    plan = []
+    off = 0
+    n_rows = len(specs[0][2]) if specs else 0
+    padded = _bucket(n_rows)
+
+    def push(itemsize, kind):
+        nonlocal off
+        wlen = padded * itemsize // 4 if itemsize >= 4 else \
+            (padded * itemsize + 3) // 4
+        plan.append((kind, off, wlen, padded))
+        off += wlen
+
+    for name, dtype, values, validity in specs:
+        size = np.dtype(dtype.storage).itemsize if not dtype.is_decimal \
+            else dtype.itemsize
+        kind = {8: "w8", 4: "w4", 2: "w2", 1: "w1"}[size]
+        push(size, kind)
+        if validity is not None:
+            push(1, "w1")
+    return tuple(plan), off
+
+
+_ready_plans: set = set()
+_warming: set = set()
+_plans_lock = __import__("threading").Lock()
+
+
+def plan_ready(specs) -> bool:
+    """True when the staged unpack for these specs is already compiled —
+    the first-touch gate: a cold scan should not stall on a (remote)
+    compile when per-column transfers can ship now."""
+    plan, total = _plan_for(specs)
+    with _plans_lock:
+        return (plan, total) in _ready_plans
+
+
+def warm_plan_async(specs) -> None:
+    """Compile the staged unpack for these specs on a background thread so
+    the NEXT scan of this (schema, row-bucket) takes the single-transfer
+    path.  Idempotent; never blocks the caller."""
+    import threading
+    plan, total = _plan_for(specs)
+    key = (plan, total)
+    with _plans_lock:
+        if key in _ready_plans or key in _warming:
+            return
+        _warming.add(key)
+
+    def work():
+        try:
+            _unpack.lower(jax.ShapeDtypeStruct((total,), jnp.uint32),
+                          plan).compile()
+            with _plans_lock:
+                _ready_plans.add(key)
+        except Exception:
+            pass
+        finally:
+            with _plans_lock:
+                _warming.discard(key)
+
+    threading.Thread(target=work, daemon=True).start()
+
+
 def stage_fixed_table(specs) -> Table:
     """``specs``: list of (name, dtype, values_np, validity_np_or_None) for
     fixed-width dtypes only.  One host pack, ONE device transfer, one fused
@@ -109,6 +176,8 @@ def stage_fixed_table(specs) -> Table:
 
     words = jnp.asarray(np.frombuffer(bytes(blob), np.uint32))  # ONE put
     arrays = _unpack(words, tuple(plan))
+    with _plans_lock:
+        _ready_plans.add((tuple(plan), len(blob) // 4))
     cols, names = [], []
     ai = 0
     for name, dtype, has_valid, n in posts:

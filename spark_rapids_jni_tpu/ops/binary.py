@@ -244,7 +244,14 @@ def round_(a: Column, scale: int = 0) -> Column:
     hundreds, ...) and pass through otherwise.  Integral results that
     would exceed int64 saturate at the largest representable multiple of
     the rounding unit; ``scale <= -19`` exceeds int64 entirely and
-    raises."""
+    raises.
+
+    Known divergence (documented, like the reference plugin's float-round
+    caveats): doubles round via v * 10^scale then HALF_UP, while Spark goes
+    through BigDecimal.valueOf(double) — the SHORTEST decimal
+    representation.  Values whose scaled product falls on the other side
+    of .5 from their shortest-repr digit string can differ in the last
+    digit (none of the classic 2.675/0.285/1.005 cases do)."""
     if a.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
         v = a.float_values().astype(jnp.float64)
         p = 10.0 ** scale

@@ -308,6 +308,53 @@ def _hash_table(table: Table, seed: int, int_fn, long_fn, bytes_fn, init_cast):
     return h
 
 
+def murmur3_hash_specs(cols, specs, seed: int = DEFAULT_SEED) -> jnp.ndarray:
+    """Spark ``hash()`` (u32 bits) over a column list where some ORIGINAL
+    columns appear exploded as (length, word...) groups
+    (parallel/stringplane).
+
+    ``specs``: per original column, ("fixed", idx) or
+    ("string", len_idx, (word_idx, ...)).  Exploded string groups hash
+    their UTF-8 bytes via ``_murmur_bytes`` — BIT-EXACT with hashing the
+    original STRING column (Spark UTF8String murmur3), not the exploded
+    representation.  Null columns pass the running seed through, with a
+    string group's validity carried by its length column.
+    """
+    n = None
+    for spec in specs:
+        c = cols[spec[1]]
+        if c is not None:
+            n = c.data.shape[0]
+            break
+    h = jnp.full((n,), _U32(np.uint32(seed)))
+    for spec in specs:
+        if spec[0] == "fixed":
+            col = cols[spec[1]]
+            kind = _lane_kind(col.dtype)
+            if kind == "bytes":
+                mat, lengths = to_padded_bytes(col)
+                nh = _murmur_bytes(mat, lengths, h)
+            elif kind == "int":
+                nh = _murmur_int(_int_lane_u32(col), h)
+            else:
+                v = _long_lane_u64(col)
+                nh = _murmur_long((v & _U64(0xFFFFFFFF)).astype(jnp.uint32),
+                                  (v >> _U64(32)).astype(jnp.uint32), h)
+            valid = col.validity
+        else:
+            len_col = cols[spec[1]]
+            words = jnp.stack([cols[i].data for i in spec[2]], axis=1)
+            mat = jax.lax.bitcast_convert_type(
+                jnp.asarray(words, jnp.uint32), jnp.uint8).reshape(
+                    n, 4 * len(spec[2]))
+            nh = _murmur_bytes(mat, len_col.data.astype(jnp.int32), h)
+            valid = len_col.validity
+        if valid is not None:
+            nh = jnp.where(valid, nh, h)
+        h = nh
+    return h
+
+
 @traced("murmur3_hash")
 def murmur3_hash(table: Table | Column, seed: int = DEFAULT_SEED) -> Column:
     """Spark ``hash(...)``: Murmur3_x86_32 chained across columns -> INT32."""

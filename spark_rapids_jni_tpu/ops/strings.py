@@ -366,16 +366,29 @@ def split(col: Column, delim) -> Column:
     len_np = np.asarray(lengths).astype(np.int64)
     mat_np = np.asarray(mat)
     total_np = np.asarray(total).astype(np.int64)
+    nparts_row = total_np + 1
+    if col.validity is not None:
+        # null rows get EMPTY list ranges (the engine-wide Arrow
+        # convention), not a phantom one-part list; their delimiter hits
+        # and lengths are zeroed so starts_d stays aligned with the
+        # non-first parts below
+        vnp = np.asarray(col.validity)
+        nparts_row[~vnp] = 0
+        act_np = act_np.copy()
+        act_np[~vnp] = False
+        len_np = len_np.copy()
+        len_np[~vnp] = 0
     loffsets = np.zeros(n + 1, np.int64)
-    np.cumsum(total_np + 1, out=loffsets[1:])
+    np.cumsum(nparts_row, out=loffsets[1:])
     # vectorized part boundaries: delimiter starts (row-major order) split
     # each row into parts; a part's bytes are [prev_end, start), the last
     # part ends at the row length.  No per-part Python loop.
     rows_d, starts_d = np.nonzero(act_np)        # in row-major order
     nparts = int(loffsets[-1])
-    part_row = np.repeat(np.arange(n), total_np + 1)
+    part_row = np.repeat(np.arange(n), nparts_row)
+    nonempty = nparts_row > 0                    # null rows have no parts
     first = np.zeros(nparts, np.bool_)
-    first[loffsets[:-1]] = True
+    first[loffsets[:-1][nonempty]] = True
     part_start = np.zeros(nparts, np.int64)
     part_end = np.empty(nparts, np.int64)
     # parts after a delimiter start at delim_pos + len(d); each row's
@@ -384,7 +397,7 @@ def split(col: Column, delim) -> Column:
     part_end[:] = len_np[part_row]
     # non-last parts end at their delimiter's position
     last = np.zeros(nparts, np.bool_)
-    last[loffsets[1:] - 1] = True
+    last[loffsets[1:][nonempty] - 1] = True
     part_end[~last] = starts_d
     plens = np.maximum(part_end - part_start, 0)
     offsets = np.zeros(nparts + 1, np.int64)

@@ -34,8 +34,9 @@ from ..ops.row_conversion import fixed_width_layout, _build_planes, \
     _from_planes
 from .mesh import ROW_AXIS, axis_size
 from ..utils.tracing import traced
-from .shuffle import (partition_ids, cap_bucket, cap_bucket_fine,
-                      exchange_planes, partition_counts)
+from .shuffle import (partition_ids, partition_ids_specs, key_specs_for,
+                      cap_bucket, cap_bucket_fine, exchange_planes,
+                      partition_counts)
 
 # (partial op emitted by the local pass, final re-aggregation op)
 _REAGG = {"sum": "sum", "count": "sum", "count_all": "sum",
@@ -101,7 +102,8 @@ def _padded_table(out_keys, out_aggs, key_names):
 def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
                               key_names: tuple, aggs: tuple,
                               capacity: int, axis: str = ROW_AXIS,
-                              masked: bool = False):
+                              masked: bool = False,
+                              key_specs: tuple | None = None):
     """Compile-once distributed GROUP BY for a fixed schema.
 
     Returns fn(datas, masks[, n_valid]) -> (key+agg padded buffers, live
@@ -167,9 +169,15 @@ def build_distributed_groupby(mesh: Mesh, schema: tuple, names: tuple,
         pdatas = tuple(c.data for c in partial_tbl.columns)
         pmasks = tuple(c.validity for c in partial_tbl.columns)
 
-        # 2. exchange partial groups by key hash (word planes over ICI)
-        key_cols = [partial_tbl.column(i) for i in range(len(key_names))]
-        dest = partition_ids(Table(key_cols), ndev)
+        # 2. exchange partial groups by key hash (word planes over ICI);
+        # string keys partition by Spark UTF8String murmur3 over their
+        # exploded words (partition_ids_specs)
+        if key_specs is not None:
+            dest = partition_ids_specs(list(partial_tbl.columns),
+                                       key_specs, ndev)
+        else:
+            key_cols = [partial_tbl.column(i) for i in range(len(key_names))]
+            dest = partition_ids(Table(key_cols), ndev)
         planes = _build_planes(playout, pdatas, pmasks)
         planes_in, mask_in, overflow = exchange_planes(
             planes, dest, live_local, ndev, capacity, axis)
@@ -252,7 +260,9 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
                            rschema: tuple, rnames: tuple,
                            on_left: tuple, on_right: tuple, how: str,
                            lcap: int, rcap: int, jcap: int,
-                           axis: str = ROW_AXIS):
+                           axis: str = ROW_AXIS,
+                           lkey_specs: tuple | None = None,
+                           rkey_specs: tuple | None = None):
     """Compile-once distributed equi-join for fixed schemas.
 
     The physical plan Spark runs as GpuShuffledHashJoin/SortMergeJoin
@@ -269,11 +279,15 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
     llayout = fixed_width_layout(list(lschema))
     rlayout = fixed_width_layout(list(rschema))
 
-    def exchange(layout, names, schema, datas, masks, key_names, cap):
+    def exchange(layout, names, schema, datas, masks, key_names, cap,
+                 kspecs):
         tbl = Table([Column(dt_, data=d, validity=m)
                      for dt_, d, m in zip(schema, datas, masks)], list(names))
-        keys = [tbl.column(k) for k in key_names]
-        dest = partition_ids(Table(keys), ndev)
+        if kspecs is not None:
+            dest = partition_ids_specs(list(tbl.columns), kspecs, ndev)
+        else:
+            keys = [tbl.column(k) for k in key_names]
+            dest = partition_ids(Table(keys), ndev)
         planes = _build_planes(layout, datas, masks)
         planes_in, live_in, overflow = exchange_planes(
             planes, dest, None, ndev, cap, axis)
@@ -285,9 +299,9 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
 
     def shard_fn(ldatas, lmasks, rdatas, rmasks):
         ltbl, llive, lovf = exchange(llayout, lnames, lschema, ldatas,
-                                     lmasks, on_left, lcap)
+                                     lmasks, on_left, lcap, lkey_specs)
         rtbl, rlive, rovf = exchange(rlayout, rnames, rschema, rdatas,
-                                     rmasks, on_right, rcap)
+                                     rmasks, on_right, rcap, rkey_specs)
         li, ri, jlive, npairs, jovf = inner_join_padded(
             ltbl, rtbl, list(on_left), list(on_right), jcap,
             left_live=llive, right_live=rlive)
@@ -422,13 +436,21 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
         raise TypeError(
             f"join key shapes disagree after explosion: {lkeys} vs {rkeys} "
             "(string keys must pair with string keys)")
+    # Spark-exact partitioning: string keys hash their UTF-8 bytes, and
+    # CO-PARTITIONING demands the two sides agree — the byte hash does by
+    # construction (the exploded-representation hash only agreed because
+    # widths were forced equal)
+    lkey_specs = key_specs_for(lt, on_left, lplan)
+    rkey_specs = key_specs_for(rt, on_right, rplan)
     auto_cap = capacity is None
     auto_jcap = join_capacity is None
     if auto_cap:
         # two-phase exchange: counts are exact for joins (no pre-agg dedup);
         # each side sized independently (builder takes lcap/rcap)
-        lcounts = partition_counts(lt, mesh, lkeys, axis)
-        rcounts = partition_counts(rt, mesh, rkeys, axis)
+        lcounts = partition_counts(lt, mesh, lkeys, axis,
+                                   key_specs=lkey_specs)
+        rcounts = partition_counts(rt, mesh, rkeys, axis,
+                                   key_specs=rkey_specs)
         lcap = cap_bucket(int(lcounts.max()))
         rcap = cap_bucket(int(rcounts.max()))
         if auto_jcap:
@@ -457,7 +479,7 @@ def distributed_join(left: Table, right: Table, mesh: Mesh, on_left,
         fn = build_distributed_join(
             mesh, tuple(lt.dtypes()), lnames, tuple(rt.dtypes()), rnames,
             tuple(lkeys), tuple(rkeys), how, lcap, rcap,
-            join_capacity, axis)
+            join_capacity, axis, lkey_specs, rkey_specs)
         (lsel, lselv, rsel, rselv, live, _n, xovf, jovf) = fn(
             *largs, *rargs)
         if int(xovf) > 0:
@@ -747,11 +769,18 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
         # strings couldn't shard before explosion; place the exploded
         # fixed-width buffers on the mesh now
         table = shard_table(table, mesh, axis)
+    # Spark-exact partition hashing (string keys by UTF8 murmur3): specs
+    # over the full exploded table for the counts pass, and over the
+    # partial-group table (keys lead its columns) for the exchange
+    tbl_specs = key_specs_for(table, orig_keys, plan)
+    kcols = Table([table.column(k) for k in key_names], list(key_names))
+    partial_specs = key_specs_for(kcols, orig_keys, plan)
     if capacity is None:
         # two-phase exchange: raw-row partition counts upper-bound the
         # partial-group rows each shard sends (local agg only dedups)
         counts = partition_counts(table, mesh, list(key_names), axis,
-                                  n_valid_rows=n_valid_rows)
+                                  n_valid_rows=n_valid_rows,
+                                  key_specs=tbl_specs)
         shard_rows = table.num_rows // ndev
         capacity = min(cap_bucket(int(counts.max())),
                        cap_bucket(shard_rows))
@@ -759,7 +788,7 @@ def distributed_groupby(table: Table, mesh: Mesh, key_names: list,
         mesh, tuple(table.dtypes()),
         tuple(table.names or [f"c{i}" for i in range(table.num_columns)]),
         tuple(key_names), tuple(aggs), capacity, axis,
-        masked=n_valid_rows is not None)
+        masked=n_valid_rows is not None, key_specs=partial_specs)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
     if n_valid_rows is not None:

@@ -47,6 +47,66 @@ def partition_ids(key_table: Table, num_partitions: int) -> jnp.ndarray:
     return jnp.where(m < 0, m + jnp.int32(num_partitions), m)
 
 
+def partition_ids_specs(cols, key_specs, num_partitions: int) -> jnp.ndarray:
+    """Spark HashPartitioning over possibly-EXPLODED key columns.
+
+    ``key_specs`` (static, per original key): ("fixed", idx, dtype) or
+    ("string", len_idx, (word_idx, ...)) into ``cols``.  String keys hash
+    their UTF-8 bytes (Spark UTF8String murmur3) reconstructed from the
+    exploded (length, words) group — wire-exact partition placement, the
+    interop half of keeping the row-blob format bit-exact
+    (RowConversion.java:28-48).
+    """
+    from ..ops.hash import murmur3_hash_specs
+    hs = tuple(("fixed", s[1]) if s[0] == "fixed" else s for s in key_specs)
+    h = jax.lax.bitcast_convert_type(
+        murmur3_hash_specs(cols, hs), jnp.int32)
+    m = h % jnp.int32(num_partitions)
+    return jnp.where(m < 0, m + jnp.int32(num_partitions), m)
+
+
+def key_specs_for(table: Table, keys, plan) -> tuple:
+    """Static key specs for ``partition_ids_specs`` over a possibly-exploded
+    table: ``keys`` are the ORIGINAL key names (or indices when nothing was
+    exploded), ``plan`` the StringPlan (or None)."""
+    from .stringplane import LEN_SUFFIX, WORD_SUFFIX
+    spec_of = dict(zip(plan.names, plan.specs)) if plan is not None else {}
+    names = list(table.names or [f"c{i}" for i in range(table.num_columns)])
+    out = []
+    for k in keys:
+        s = spec_of.get(k, ("fixed",)) if isinstance(k, str) else ("fixed",)
+        if s[0] == "string":
+            li = names.index(f"{k}{LEN_SUFFIX}")
+            out.append(("string", li,
+                        tuple(names.index(f"{k}{WORD_SUFFIX}{i}")
+                              for i in range(s[1]))))
+        else:
+            i = names.index(k) if isinstance(k, str) else int(k)
+            out.append(("fixed", i, table.columns[i].dtype))
+    return tuple(out)
+
+
+def _spec_columns(key_specs, datas, masks):
+    """Columns referenced by ``key_specs``, built from raw shard buffers
+    (positions not referenced stay None)."""
+    from ..dtypes import INT32 as _I32DT, UINT32 as _U32DT
+    cols = [None] * len(datas)
+
+    def put(i, dtype):
+        if cols[i] is None:
+            cols[i] = Column(dtype, data=datas[i],
+                             validity=None if masks[i] is None else masks[i])
+
+    for s in key_specs:
+        if s[0] == "fixed":
+            put(s[1], s[2])
+        else:
+            put(s[1], _I32DT)
+            for i in s[2]:
+                put(i, _U32DT)
+    return cols
+
+
 def _bucket_pack_planes(planes, dest: jnp.ndarray, row_mask, ndev: int,
                         capacity: int):
     """Scatter-free bucket pack: rows into per-destination slots.
@@ -123,26 +183,25 @@ def cap_bucket_fine(count: int) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def make_partition_counts(mesh: Mesh, key_idx: tuple[int, ...],
-                          key_dtypes: tuple, axis: str = ROW_AXIS,
-                          masked: bool = False):
+def make_partition_counts(mesh: Mesh, key_specs: tuple,
+                          axis: str = ROW_AXIS, masked: bool = False):
     """Phase 1 of the two-phase exchange: per-(src, dest) row counts.
 
     SURVEY.md §7 hard part #3 (ragged all-to-all with static shapes): rather
     than guessing a capacity and retrying on overflow, a cheap counts pass
     (hash + bincount + all_gather of an ndev-vector — no payload movement)
-    sizes the payload exchange exactly.  Returns fn(datas, masks[, n_valid])
-    -> int32[ndev, ndev] with row s = counts shard s sends to each dest.
+    sizes the payload exchange exactly.  ``key_specs`` comes from
+    ``key_specs_for`` (Spark-exact hashing incl. exploded string keys).
+    Returns fn(datas, masks[, n_valid]) -> int32[ndev, ndev] with row s =
+    counts shard s sends to each dest.
     """
     ndev = axis_size(mesh, axis)
 
     def shard_fn(datas, masks, n_valid=None):
-        key_cols = [Column(kd, data=datas[i],
-                           validity=None if masks[i] is None else masks[i])
-                    for kd, i in zip(key_dtypes, key_idx)]
-        dest = partition_ids(Table(key_cols), ndev)
+        cols = _spec_columns(key_specs, datas, masks)
+        dest = partition_ids_specs(cols, key_specs, ndev)
         if n_valid is not None:
-            n_local = datas[key_idx[0]].shape[0]
+            n_local = dest.shape[0]
             shard_idx = jax.lax.axis_index(axis).astype(jnp.int64)
             gid = shard_idx * n_local + jnp.arange(n_local, dtype=jnp.int64)
             dest = jnp.where(gid < n_valid, dest, jnp.int32(ndev))
@@ -160,15 +219,14 @@ def make_partition_counts(mesh: Mesh, key_idx: tuple[int, ...],
 
 
 def partition_counts(table: Table, mesh: Mesh, keys: list,
-                     axis: str = ROW_AXIS, n_valid_rows=None):
+                     axis: str = ROW_AXIS, n_valid_rows=None,
+                     key_specs: tuple | None = None):
     """Host wrapper over ``make_partition_counts`` for a sharded table."""
     import numpy as np
-    names = table.names or [f"c{i}" for i in range(table.num_columns)]
-    key_idx = tuple(names.index(k) if isinstance(k, str) else int(k)
-                    for k in keys)
-    fn = make_partition_counts(
-        mesh, key_idx, tuple(table.columns[i].dtype for i in key_idx),
-        axis, masked=n_valid_rows is not None)
+    if key_specs is None:
+        key_specs = key_specs_for(table, keys, None)
+    fn = make_partition_counts(mesh, key_specs, axis,
+                               masked=n_valid_rows is not None)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
     if n_valid_rows is not None:
@@ -196,14 +254,16 @@ def exchange_planes(planes, dest, row_mask, ndev: int, capacity: int,
 
 
 @functools.lru_cache(maxsize=64)
-def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
-                 key_dtypes: tuple, capacity: int, axis: str = ROW_AXIS,
+def make_shuffle(mesh: Mesh, layout: RowLayout, key_specs: tuple,
+                 capacity: int, axis: str = ROW_AXIS,
                  donate: bool = False):
     """Build the jitted shard_map shuffle for a fixed schema.
 
     Returns fn(datas, masks, row_mask) -> (planes_in, ok, overflow): the
     received word planes (tuple of u32[ndev*capacity] per row word — feed
     ``_from_planes``), the live-row mask, and the global overflow count.
+    ``key_specs`` from ``key_specs_for`` — string keys partition by Spark
+    UTF8String murmur3 over their exploded words.
 
     ``donate=True`` donates the input buffers to XLA (donate_argnums — the
     async-dispatch/donation half of the reference's per-thread-stream
@@ -214,10 +274,8 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_idx: tuple[int, ...],
     ndev = axis_size(mesh, axis)
 
     def shard_fn(datas, masks, row_mask):
-        key_cols = [Column(kd, data=datas[i],
-                           validity=None if masks[i] is None else masks[i])
-                    for kd, i in zip(key_dtypes, key_idx)]
-        dest = partition_ids(Table(key_cols), ndev)
+        cols = _spec_columns(key_specs, datas, masks)
+        dest = partition_ids_specs(cols, key_specs, ndev)
         planes = _build_planes(layout, datas, masks)
         planes_in, rok, overflow = exchange_planes(planes, dest, row_mask,
                                                    ndev, capacity, axis)
@@ -248,10 +306,10 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
 
     STRING columns (keys or payloads) cross the exchange in padded-bucket
     form (stringplane): exploded to fixed-width, shuffled inside the row
-    blobs, reassembled on the way out.  NOTE: string-key partitioning
-    hashes the exploded (length, words) representation — consistent across
-    the mesh, but not Spark's UTF8String murmur3; use fixed-width or
-    dictionary codes when wire-level Spark partition parity is required.
+    blobs, reassembled on the way out.  String-key partitioning is Spark's
+    UTF8String murmur3 over the original bytes (reconstructed on device
+    from the exploded words — ``partition_ids_specs``), so partition
+    placement interoperates with Spark's HashPartitioning wire-exactly.
     """
     from ..ops.row_conversion import fixed_width_layout
     plan = None
@@ -260,21 +318,17 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
         names0 = table.names or [f"c{i}" for i in range(table.num_columns)]
         keys = [k if isinstance(k, str) else names0[int(k)] for k in keys]
         table, plan = explode_strings(table)
-        keys = plan.exploded_keys(keys)
         from .mesh import shard_table
         table = shard_table(table, mesh, axis)  # strings couldn't shard before
     layout = fixed_width_layout(table.dtypes())
     ndev = axis_size(mesh, axis)
-    names = table.names or [f"c{i}" for i in range(table.num_columns)]
-    key_idx = tuple(names.index(k) if isinstance(k, str) else int(k)
-                    for k in keys)
+    key_specs = key_specs_for(table, keys, plan)
     if capacity is None:
         # two-phase exchange: counts pass sizes the payload pass exactly
         capacity = cap_bucket(
-            int(partition_counts(table, mesh, list(key_idx), axis).max()))
-    fn = make_shuffle(mesh, layout, key_idx,
-                      tuple(table.columns[i].dtype for i in key_idx),
-                      capacity, axis, donate)
+            int(partition_counts(table, mesh, list(keys), axis,
+                                 key_specs=key_specs).max()))
+    fn = make_shuffle(mesh, layout, key_specs, capacity, axis, donate)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
     planes_in, ok, overflow = fn(datas, masks, live)

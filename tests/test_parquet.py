@@ -502,3 +502,30 @@ def test_nested_list_read_deep_and_chunked(tmp_path):
     p3 = tmp_path / "l3.parquet"
     pq.write_table(t3, p3)
     assert read_parquet(p3)["x"].to_pylist() == v3
+
+
+def test_staging_plan_for_matches_packed_plan():
+    """_plan_for (the pre-pack plan used by plan_ready/warm_plan_async) must
+    stay byte-for-byte in sync with the plan stage_fixed_table actually
+    packs — drift would silently defeat the first-touch warm cache."""
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu import dtypes as dt
+    from spark_rapids_jni_tpu.io import staging
+    rng = np.random.default_rng(0)
+    n = 1500  # off-bucket row count exercises padding
+    specs = [
+        ("a", dt.INT64, rng.integers(0, 100, n).astype(np.int64), None),
+        ("b", dt.FLOAT64, rng.standard_normal(n),
+         (rng.random(n) > 0.5).astype(np.uint8)),
+        ("c", dt.INT32, rng.integers(0, 100, n).astype(np.int32), None),
+        ("d", dt.INT16, rng.integers(0, 100, n).astype(np.int16), None),
+        ("e", dt.INT8, rng.integers(0, 100, n).astype(np.int8), None),
+        ("f", dt.BOOL8, (rng.random(n) > 0.5), None),
+    ]
+    key = staging._plan_for(specs)
+    assert not staging.plan_ready(specs) or key in staging._ready_plans
+    out = staging.stage_fixed_table(specs)
+    assert staging.plan_ready(specs), \
+        "_plan_for's key does not match the plan stage_fixed_table packed"
+    np.testing.assert_array_equal(np.asarray(out.column("a").data),
+                                  specs[0][2])

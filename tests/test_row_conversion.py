@@ -228,3 +228,142 @@ def test_decimal128_round_trip():
         else:
             assert g == decimal.Decimal(v).scaleb(-6, ctx), v
     assert back.columns[1].to_pylist() == list(range(6))
+
+
+# -- variable-width (STRING) rows -------------------------------------------
+
+def numpy_pack_var(cols_np, schema):
+    """Host oracle for the variable-width contract (independent of the
+    device kernel): fixed region with 8-byte (offset, length) string slots,
+    validity tail, align8 variable region, per-field align8 padding."""
+    from spark_rapids_jni_tpu.ops.row_conversion import variable_width_layout
+    vlay = variable_width_layout(schema)
+    base = vlay.base
+    n = len(cols_np[0][0])
+    rows = []
+    for i in range(n):
+        fixed = bytearray(base.row_size)
+        var = bytearray()
+        for ci, ((data, valid), dtp, off) in enumerate(
+                zip(cols_np, schema, base.offsets)):
+            if dtp.is_string:
+                s = data[i] if (valid is None or valid[i]) else b""
+                if isinstance(s, str):
+                    s = s.encode("utf-8")
+                foff = base.row_size + len(var)
+                fixed[off:off + 4] = np.uint32(foff).tobytes()
+                fixed[off + 4:off + 8] = np.uint32(len(s)).tobytes()
+                var += s + b"\0" * (-len(s) % 8)
+            else:
+                b = np.asarray(data[i]).tobytes()
+                fixed[off:off + len(b)] = b
+        for ci, (data, valid) in enumerate(cols_np):
+            if valid is None or valid[i]:
+                fixed[base.validity_offset + ci // 8] |= 1 << (ci % 8)
+        rows.append(bytes(fixed) + bytes(var))
+    return rows
+
+
+def make_var_table(n, seed=0):
+    rng = np.random.default_rng(seed)
+    words = ["", "a", "béta", "cherry-pie", "δelta-δelta", "x" * 37,
+             "\U0001F600smile", "tail"]
+    s1 = [words[k] for k in rng.integers(0, len(words), n)]
+    v1 = rng.random(n) > 0.2
+    s2 = [words[k] for k in rng.integers(0, len(words), n)]
+    i64 = rng.integers(-2**62, 2**62, n).astype(np.int64)
+    i32 = rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)
+    vi = rng.random(n) > 0.5
+    schema = [dt.INT64, dt.STRING, dt.INT32, dt.STRING]
+    table = Table([
+        Column.from_numpy(i64),
+        Column.from_pylist([s if ok else None for s, ok in zip(s1, v1)],
+                           dtype=dt.STRING),
+        Column.from_numpy(i32, validity=vi),
+        Column.from_pylist(list(s2), dtype=dt.STRING),
+    ])
+    cols_np = [(i64, None), (s1, v1), (i32, vi), (s2, None)]
+    return table, cols_np, schema
+
+
+def test_var_layout_slots():
+    from spark_rapids_jni_tpu.ops.row_conversion import variable_width_layout
+    vlay = variable_width_layout([dt.INT32, dt.STRING, dt.INT8])
+    # int32 at 0, string slot 8-aligned at 8, int8 at 16, validity 17,
+    # var region starts align8(18) = 24
+    assert vlay.base.offsets == (0, 8, 16)
+    assert vlay.base.validity_offset == 17
+    assert vlay.base.row_size == 24
+    assert vlay.string_idx == (1,)
+
+
+def test_var_wire_bytes_match_oracle():
+    table, cols_np, schema = make_var_table(257, seed=3)
+    blobs = convert_to_rows(table)
+    assert len(blobs) == 1
+    rows = numpy_pack_var(cols_np, schema)
+    got = blobs[0]
+    offs = np.asarray(got.offsets)
+    child = np.asarray(got.children[0].data).view(np.uint8)
+    exp_offs = np.cumsum([0] + [len(r) for r in rows])
+    np.testing.assert_array_equal(offs, exp_offs)
+    np.testing.assert_array_equal(child, np.frombuffer(
+        b"".join(rows), np.uint8))
+
+
+def test_var_roundtrip():
+    table, _, schema = make_var_table(500, seed=4)
+    blobs, parts = roundtrip(table)
+    assert sum(p.num_rows for p in parts) == table.num_rows
+    got = parts[0]
+    for ci in range(table.num_columns):
+        a, b = table.columns[ci], got.columns[ci]
+        np.testing.assert_array_equal(a.validity_numpy(), b.validity_numpy())
+        if a.dtype.is_string:
+            la, lb = a.to_pylist(), b.to_pylist()
+            va = a.validity_numpy()
+            assert [x for x, ok in zip(la, va) if ok] == \
+                [x for x, ok in zip(lb, va) if ok]
+        else:
+            va = a.validity_numpy()
+            np.testing.assert_array_equal(a.to_numpy()[va], b.to_numpy()[va])
+
+
+def test_var_all_null_and_empty_strings():
+    table = Table([
+        Column.from_pylist(["", None, "", None], dtype=dt.STRING),
+        Column.from_numpy(np.arange(4, dtype=np.int64)),
+    ])
+    blobs, parts = roundtrip(table)
+    got = parts[0]
+    np.testing.assert_array_equal(got.columns[0].validity_numpy(),
+                                  [True, False, True, False])
+    assert got.columns[0].to_pylist()[0] == ""
+    np.testing.assert_array_equal(got.columns[1].to_numpy(),
+                                  np.arange(4))
+
+
+def test_var_batching_by_bytes():
+    table, cols_np, schema = make_var_table(600, seed=5)
+    blobs = convert_to_rows(table, max_batch_bytes=8192)
+    assert len(blobs) > 1
+    rows = numpy_pack_var(cols_np, schema)
+    rejoined = b"".join(
+        np.asarray(b.children[0].data).view(np.uint8).tobytes()
+        for b in blobs)
+    assert rejoined == b"".join(rows)
+    for b in blobs[:-1]:
+        assert (np.asarray(b.offsets)[-1]) <= 8192
+    parts = [convert_from_rows(b, schema) for b in blobs]
+    assert sum(p.num_rows for p in parts) == 600
+
+
+def test_var_all_string_schema():
+    """A table whose columns are ALL strings (no fixed-width buffer to
+    derive the row count from) must still convert (reviewer regression)."""
+    t = Table([Column.from_pylist(["abc", "", "longer-string", None]),
+               Column.from_pylist(["x", "yy", None, "zzzz"])])
+    blobs = convert_to_rows(t)
+    back = convert_from_rows(blobs[0], t.dtypes())
+    assert back.columns[0].to_pylist() == ["abc", "", "longer-string", None]
+    assert back.columns[1].to_pylist() == ["x", "yy", None, "zzzz"]

@@ -310,3 +310,13 @@ def test_upper_lower_non_ascii_passthrough():
     # round trip stays valid UTF-8 byte-for-byte on the multi-byte spans
     assert s.lower(s.upper(c)).to_pylist() == \
         ["héllo", "Äbc", "straße", None, "mix017x"]
+
+
+def test_split_null_rows_get_empty_ranges():
+    """Null input rows must produce EMPTY list ranges (the engine-wide
+    Arrow convention), not a phantom one-part list (advisor r4)."""
+    c = Column.from_pylist(["a,b,c", None, "", "x,,y", None, ","])
+    out = s.split(c, ",")
+    assert np.asarray(out.offsets).tolist() == [0, 3, 3, 4, 7, 7, 9]
+    assert out.to_pylist() == [["a", "b", "c"], None, [""],
+                               ["x", "", "y"], None, ["", ""]]
