@@ -222,8 +222,7 @@ def bench_row_conversion_strings(n=2_000_000):
     # headline (salt the long column; lengths are untouched so shapes and
     # the wire sort stay identical)
     import jax
-    from spark_rapids_jni_tpu.ops.row_conversion import (
-        _to_rows_var_fused, variable_width_layout)
+    from spark_rapids_jni_tpu.ops.row_conversion import _to_rows_var_fused
     vlay = variable_width_layout(table.dtypes())
     soffs = (jnp.asarray(table.columns[1].offsets, jnp.int32),)
     schars = (jnp.asarray(table.columns[1].data, jnp.uint8),)
@@ -253,7 +252,6 @@ def bench_row_conversion_strings(n=2_000_000):
     per = min(_timed(jf, args) for _ in range(3)) / K
     dev_gbps = total / per / 1e9
 
-    vlay = variable_width_layout([dt.INT64, dt.STRING])
     t0 = time.perf_counter()
     ref = numpy_pack_var(i64, chars, lens, vlay)
     cpu_s = time.perf_counter() - t0

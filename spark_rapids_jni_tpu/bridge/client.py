@@ -210,3 +210,62 @@ class BridgeClient:
     def free_shm(self, name: str) -> None:
         nameb = name.encode()
         self._call(P.OP_FREE_SHM, struct.pack("<I", len(nameb)) + nameb)
+
+    # -- engine ops (handle in, handle out) --------------------------------
+
+    def get_column(self, table_handle: int, idx: int) -> int:
+        (h,) = struct.unpack("<Q", self._call(
+            P.OP_GET_COLUMN, struct.pack("<QI", table_handle, idx)))
+        return h
+
+    def make_table(self, col_handles: list[int]) -> int:
+        body = struct.pack("<I", len(col_handles)) + b"".join(
+            struct.pack("<Q", h) for h in col_handles)
+        (h,) = struct.unpack("<Q", self._call(P.OP_MAKE_TABLE, body))
+        return h
+
+    def hash(self, table_handle: int, kind: str = "murmur3",
+             seed: int = 42) -> int:
+        k = {"murmur3": 0, "xxhash64": 1}[kind]
+        (h,) = struct.unpack("<Q", self._call(
+            P.OP_HASH, struct.pack("<QBi", table_handle, k, seed)))
+        return h
+
+    def cast_strings(self, col_handle: int, dtype: DType,
+                     ansi: bool = False, strip: bool = False) -> int:
+        (h,) = struct.unpack("<Q", self._call(
+            P.OP_CAST_STRINGS,
+            struct.pack("<QiiBB", col_handle, int(dtype.id), dtype.scale,
+                        int(ansi), int(strip))))
+        return h
+
+    def groupby(self, table_handle: int, key_idx: list[int],
+                aggs: list[tuple[int, int]]) -> int:
+        """``aggs``: (column index, P.AGG_* code) pairs."""
+        body = struct.pack("<QI", table_handle, len(key_idx))
+        body += b"".join(struct.pack("<I", i) for i in key_idx)
+        body += struct.pack("<I", len(aggs))
+        body += b"".join(struct.pack("<IB", ci, ac) for ci, ac in aggs)
+        (h,) = struct.unpack("<Q", self._call(P.OP_GROUPBY, body))
+        return h
+
+    def join(self, left_handle: int, right_handle: int, left_keys: list[int],
+             right_keys: list[int], how: str = "inner") -> int:
+        code = {v: k for k, v in P.JOIN_NAMES.items()}[how]
+        body = struct.pack("<QQB", left_handle, right_handle, code)
+        body += struct.pack("<I", len(left_keys))
+        body += b"".join(struct.pack("<I", i) for i in left_keys)
+        body += b"".join(struct.pack("<I", i) for i in right_keys)
+        (h,) = struct.unpack("<Q", self._call(P.OP_JOIN, body))
+        return h
+
+    def read_parquet(self, path: str, columns: list[str] | None = None) -> int:
+        pb = path.encode()
+        body = struct.pack("<I", len(pb)) + pb
+        cols = columns or []
+        body += struct.pack("<I", len(cols))
+        for c in cols:
+            cb = c.encode()
+            body += struct.pack("<I", len(cb)) + cb
+        (h,) = struct.unpack("<Q", self._call(P.OP_READ_PARQUET, body))
+        return h

@@ -99,6 +99,7 @@ def _plan_for(specs):
 
 _ready_plans: set = set()
 _warming: set = set()
+_failed_plans: set = set()
 _plans_lock = __import__("threading").Lock()
 
 
@@ -119,7 +120,7 @@ def warm_plan_async(specs) -> None:
     plan, total = _plan_for(specs)
     key = (plan, total)
     with _plans_lock:
-        if key in _ready_plans or key in _warming:
+        if key in _ready_plans or key in _warming or key in _failed_plans:
             return
         _warming.add(key)
 
@@ -129,8 +130,15 @@ def warm_plan_async(specs) -> None:
                           plan).compile()
             with _plans_lock:
                 _ready_plans.add(key)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — backend may reject the plan
+            # memoize the failure: re-spawning a doomed multi-second compile
+            # on every scan would burn CPU forever with zero diagnostics
+            with _plans_lock:
+                _failed_plans.add(key)
+            from ..utils.config import logger
+            logger().warning("staged unpack compile failed (%d cols); "
+                             "scans stay per-column: %s: %s",
+                             len(specs), type(e).__name__, e)
         finally:
             with _plans_lock:
                 _warming.discard(key)

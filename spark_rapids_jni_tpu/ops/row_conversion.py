@@ -649,7 +649,7 @@ def _convert_to_rows_var(table: Table, max_batch_bytes: int) -> list[Column]:
         row_sizes = row_sizes + ((lengths.astype(jnp.int64) + 7) // 8 * 8)
     row_ends = jnp.cumsum(row_sizes)
 
-    def emit(start, stop, base_off, total_words, row_off4, ends):
+    def emit(start, stop, total_words, row_off4, ends):
         bdatas = tuple(None if d is None else d[start:stop] for d in datas)
         bmasks = tuple(None if m is None else m[start:stop] for m in masks)
         bwords = tuple(words.reshape(-1, w // 4)[start:stop].reshape(-1)
@@ -671,22 +671,36 @@ def _convert_to_rows_var(table: Table, max_batch_bytes: int) -> list[Column]:
     out = []
     start = 0
     while start < n:
-        # batch greedily by bytes, 32-row aligned (reference
-        # row_conversion.cu:476-511)
+        # batch greedily by bytes, 32-row aligned when at least one whole
+        # group fits (reference row_conversion.cu:476-511); searchsorted
+        # gives >= start+1 because every single row fits max_batch_bytes
         base_off = int(ends_np[start - 1]) if start else 0
         stop = int(np.searchsorted(ends_np, base_off + max_batch_bytes,
                                    side="right"))
         if stop < n:
-            stop = max(start + 1,
-                       start + (stop - start) // BATCH_ROW_ALIGN *
-                       BATCH_ROW_ALIGN)
+            aligned = start + (stop - start) // BATCH_ROW_ALIGN * \
+                BATCH_ROW_ALIGN
+            stop = aligned if aligned > start else stop
         total_words = int(ends_np[stop - 1] - base_off) // 4
         row_off4 = ((row_ends[start:stop] - row_sizes[start:stop]
                      - base_off) // 4).astype(jnp.int32)
-        out.append(emit(start, stop, base_off, total_words, row_off4,
+        out.append(emit(start, stop, total_words, row_off4,
                         row_ends[start:stop] - base_off))
         start = stop
     return out
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_probe(vlayout: VarRowLayout, wire, row_off4):
+    """Max string length per string column, stacked — one fetch."""
+    base = vlayout.base
+    outs = []
+    for idx in vlayout.string_idx:
+        slot_word = base.offsets[idx] // 4 + 1
+        lens = jnp.take(wire, jnp.clip(row_off4 + slot_word, 0,
+                                       max(wire.shape[0] - 1, 0)))
+        outs.append(jnp.max(lens).astype(jnp.int64))
+    return jnp.stack(outs)
 
 
 def _convert_from_rows_var(rows: Column, schema: Sequence[DType]) -> Table:
@@ -709,15 +723,14 @@ def _convert_from_rows_var(rows: Column, schema: Sequence[DType]) -> Table:
             jnp.asarray(child.data, jnp.uint8).reshape(-1, 4), jnp.uint32)
     row_off4 = (offs[:-1] // 4).astype(jnp.int32)
 
-    # scalar host syncs size the padded string matrices (trace-stable
-    # align8 buckets); the length vectors stay on device
-    swidths = []
-    for k, idx in enumerate(vlayout.string_idx):
-        slot_word = base.offsets[idx] // 4 + 1
-        mx = int(jnp.max(jnp.take(
-            wire, jnp.clip(row_off4 + slot_word, 0,
-                           max(wire.shape[0] - 1, 0))))) if n else 0
-        swidths.append(max(8, (mx + 7) // 8 * 8))
+    # ONE host sync sizes every padded string matrix (trace-stable align8
+    # buckets) — the mirror of _var_probe on the to-rows side; per-column
+    # fetches would pay one tunnel round trip each
+    if n and vlayout.string_idx:
+        maxes = np.asarray(_from_rows_probe(vlayout, wire, row_off4))
+        swidths = [max(8, (int(mx) + 7) // 8 * 8) for mx in maxes]
+    else:
+        swidths = [8] * len(vlayout.string_idx)
 
     datas, masks, strings = _from_rows_var(vlayout, tuple(swidths), n,
                                            wire, row_off4)

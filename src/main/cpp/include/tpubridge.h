@@ -106,6 +106,51 @@ typedef struct {
 int tpub_export_rows(tpub_ctx *ctx, uint64_t column, tpub_rows *out);
 void tpub_free_rows(tpub_rows *r);
 
+/* engine ops -------------------------------------------------------------- */
+/* Each op follows the reference's three-file extension pattern
+ * (RowConversionJni.cpp:24-66): handle in, handle out, errors via
+ * tpub_last_error.  Column indices are 0-based positions in the table. */
+
+/* Pick one column of a table as a standalone column handle. */
+int tpub_get_column(tpub_ctx *ctx, uint64_t table, int32_t idx,
+                    uint64_t *out);
+
+/* Assemble column handles into a new table handle. */
+int tpub_make_table(tpub_ctx *ctx, const uint64_t *cols, int32_t ncols,
+                    uint64_t *out);
+
+/* Spark hash() / xxhash64() over all columns of a table, null-chained.
+ * kind: 0 = murmur3 (INT32 result column), 1 = xxhash64 (INT64). */
+int tpub_hash(tpub_ctx *ctx, uint64_t table, int32_t kind, int32_t seed,
+              uint64_t *out);
+
+/* CastStrings: STRING column -> numeric column of (type_id, scale) with
+ * Spark semantics; ansi != 0 raises on malformed input instead of nulling,
+ * strip != 0 trims whitespace first. */
+int tpub_cast_strings(tpub_ctx *ctx, uint64_t column, int32_t type_id,
+                      int32_t scale, int32_t ansi, int32_t strip,
+                      uint64_t *out);
+
+/* GROUP BY key columns with aggregations.  agg_ops codes: 0 sum, 1 count,
+ * 2 min, 3 max, 4 mean, 5 count_all, 6 var, 7 std, 8 sumsq.  Output table:
+ * key columns then one column per aggregation. */
+int tpub_groupby(tpub_ctx *ctx, uint64_t table, const int32_t *key_idx,
+                 int32_t nkeys, const int32_t *agg_cols,
+                 const int32_t *agg_ops, int32_t naggs, uint64_t *out);
+
+/* Equi-join.  how: 0 inner, 1 left, 2 right, 3 full, 4 semi, 5 anti,
+ * 6 cross.  Output: left columns then right non-key columns (semi/anti:
+ * left columns only). */
+int tpub_join(tpub_ctx *ctx, uint64_t left, uint64_t right,
+              const int32_t *left_keys, const int32_t *right_keys,
+              int32_t nkeys, int32_t how, uint64_t *out);
+
+/* Scan a parquet file (server-visible path) into a device table; columns
+ * optionally projects by name (NULL/0 = all). */
+int tpub_read_parquet(tpub_ctx *ctx, const char *path,
+                      const char *const *columns, int32_t ncols,
+                      uint64_t *out);
+
 /* lifecycle --------------------------------------------------------------- */
 int tpub_release(tpub_ctx *ctx, uint64_t handle);
 int tpub_live_count(tpub_ctx *ctx, int32_t *out);

@@ -42,6 +42,13 @@ enum Op : uint8_t {
   OP_SHUTDOWN = 9,
   OP_FREE_SHM = 10,
   OP_TABLE_META = 11,
+  OP_GET_COLUMN = 13,
+  OP_MAKE_TABLE = 14,
+  OP_HASH = 15,
+  OP_CAST_STRINGS = 16,
+  OP_GROUPBY = 17,
+  OP_JOIN = 18,
+  OP_READ_PARQUET = 19,
 };
 
 constexpr uint8_t STATUS_OK = 0;
@@ -545,6 +552,105 @@ void tpub_free_rows(tpub_rows *r) {
     std::free(r->block);
     r->block = nullptr;
   }
+}
+
+/* shared tail for ops whose response is a single u64 handle */
+static int call_handle_out(tpub_ctx *ctx, uint8_t opcode,
+                           const std::vector<uint8_t> &payload,
+                           uint64_t *out) {
+  std::vector<uint8_t> resp;
+  if (ctx->call(opcode, payload, resp) != 0) return -1;
+  if (resp.size() != 8) return ctx->fail("bad handle response");
+  *out = get<uint64_t>(resp.data());
+  return 0;
+}
+
+int tpub_get_column(tpub_ctx *ctx, uint64_t table, int32_t idx,
+                    uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, table);
+  put<uint32_t>(payload, (uint32_t)idx);
+  return call_handle_out(ctx, OP_GET_COLUMN, payload, out);
+}
+
+int tpub_make_table(tpub_ctx *ctx, const uint64_t *cols, int32_t ncols,
+                    uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint32_t>(payload, (uint32_t)ncols);
+  for (int32_t i = 0; i < ncols; ++i) put<uint64_t>(payload, cols[i]);
+  return call_handle_out(ctx, OP_MAKE_TABLE, payload, out);
+}
+
+int tpub_hash(tpub_ctx *ctx, uint64_t table, int32_t kind, int32_t seed,
+              uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, table);
+  payload.push_back((uint8_t)kind);
+  put<int32_t>(payload, seed);
+  return call_handle_out(ctx, OP_HASH, payload, out);
+}
+
+int tpub_cast_strings(tpub_ctx *ctx, uint64_t column, int32_t type_id,
+                      int32_t scale, int32_t ansi, int32_t strip,
+                      uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, column);
+  put<int32_t>(payload, type_id);
+  put<int32_t>(payload, scale);
+  payload.push_back(ansi ? 1 : 0);
+  payload.push_back(strip ? 1 : 0);
+  return call_handle_out(ctx, OP_CAST_STRINGS, payload, out);
+}
+
+int tpub_groupby(tpub_ctx *ctx, uint64_t table, const int32_t *key_idx,
+                 int32_t nkeys, const int32_t *agg_cols,
+                 const int32_t *agg_ops, int32_t naggs, uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, table);
+  put<uint32_t>(payload, (uint32_t)nkeys);
+  for (int32_t i = 0; i < nkeys; ++i)
+    put<uint32_t>(payload, (uint32_t)key_idx[i]);
+  put<uint32_t>(payload, (uint32_t)naggs);
+  for (int32_t i = 0; i < naggs; ++i) {
+    put<uint32_t>(payload, (uint32_t)agg_cols[i]);
+    payload.push_back((uint8_t)agg_ops[i]);
+  }
+  return call_handle_out(ctx, OP_GROUPBY, payload, out);
+}
+
+int tpub_join(tpub_ctx *ctx, uint64_t left, uint64_t right,
+              const int32_t *left_keys, const int32_t *right_keys,
+              int32_t nkeys, int32_t how, uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, left);
+  put<uint64_t>(payload, right);
+  payload.push_back((uint8_t)how);
+  put<uint32_t>(payload, (uint32_t)nkeys);
+  for (int32_t i = 0; i < nkeys; ++i)
+    put<uint32_t>(payload, (uint32_t)left_keys[i]);
+  for (int32_t i = 0; i < nkeys; ++i)
+    put<uint32_t>(payload, (uint32_t)right_keys[i]);
+  return call_handle_out(ctx, OP_JOIN, payload, out);
+}
+
+int tpub_read_parquet(tpub_ctx *ctx, const char *path,
+                      const char *const *columns, int32_t ncols,
+                      uint64_t *out) {
+  std::vector<uint8_t> payload;
+  uint32_t plen = (uint32_t)std::strlen(path);
+  put<uint32_t>(payload, plen);
+  payload.insert(payload.end(), (const uint8_t *)path,
+                 (const uint8_t *)path + plen);
+  put<uint32_t>(payload, (uint32_t)(columns ? ncols : 0));
+  if (columns) {
+    for (int32_t i = 0; i < ncols; ++i) {
+      uint32_t cl = (uint32_t)std::strlen(columns[i]);
+      put<uint32_t>(payload, cl);
+      payload.insert(payload.end(), (const uint8_t *)columns[i],
+                     (const uint8_t *)columns[i] + cl);
+    }
+  }
+  return call_handle_out(ctx, OP_READ_PARQUET, payload, out);
 }
 
 int tpub_release(tpub_ctx *ctx, uint64_t handle) {

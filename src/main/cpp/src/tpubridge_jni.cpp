@@ -233,6 +233,154 @@ Java_com_nvidia_spark_rapids_jni_TpuBridge_exportTableNative(JNIEnv *env,
   return out;
 }
 
+/* -- engine ops (the three-file per-op pattern, RowConversionJni.cpp:24-66:
+ * one Java class + one JNI entry + one opcode per op) ---------------------- */
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_Hash_hashNative(JNIEnv *env, jclass,
+                                                 jlong table, jint kind,
+                                                 jint seed) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  uint64_t out = 0;
+  if (tpub_hash(ctx.get(), (uint64_t)table, (int32_t)kind, (int32_t)seed,
+                &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_CastStrings_castNative(
+    JNIEnv *env, jclass, jlong column, jint typeId, jint scale,
+    jboolean ansi, jboolean strip) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  uint64_t out = 0;
+  if (tpub_cast_strings(ctx.get(), (uint64_t)column, (int32_t)typeId,
+                        (int32_t)scale, ansi ? 1 : 0, strip ? 1 : 0,
+                        &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_getColumnNative(JNIEnv *env, jclass,
+                                                          jlong table,
+                                                          jint idx) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  uint64_t out = 0;
+  if (tpub_get_column(ctx.get(), (uint64_t)table, (int32_t)idx, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_makeTableNative(JNIEnv *env, jclass,
+                                                          jlongArray jcols) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  jsize n = env->GetArrayLength(jcols);
+  std::vector<jlong> cols(n);
+  env->GetLongArrayRegion(jcols, 0, n, cols.data());
+  std::vector<uint64_t> handles(cols.begin(), cols.end());
+  uint64_t out = 0;
+  if (tpub_make_table(ctx.get(), handles.data(), (int32_t)n, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_groupByNative(
+    JNIEnv *env, jclass, jlong table, jintArray jkeys, jintArray jaggCols,
+    jintArray jaggOps) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  jsize nk = env->GetArrayLength(jkeys);
+  jsize na = env->GetArrayLength(jaggCols);
+  if (env->GetArrayLength(jaggOps) != na) {
+    throw_runtime(env, "aggCols/aggOps length mismatch");
+    return 0;
+  }
+  std::vector<jint> keys(nk), acols(na), aops(na);
+  env->GetIntArrayRegion(jkeys, 0, nk, keys.data());
+  env->GetIntArrayRegion(jaggCols, 0, na, acols.data());
+  env->GetIntArrayRegion(jaggOps, 0, na, aops.data());
+  uint64_t out = 0;
+  if (tpub_groupby(ctx.get(), (uint64_t)table,
+                   (const int32_t *)keys.data(), (int32_t)nk,
+                   (const int32_t *)acols.data(),
+                   (const int32_t *)aops.data(), (int32_t)na, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_joinNative(
+    JNIEnv *env, jclass, jlong left, jlong right, jintArray jlkeys,
+    jintArray jrkeys, jint how) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  jsize nk = env->GetArrayLength(jlkeys);
+  if (env->GetArrayLength(jrkeys) != nk) {
+    throw_runtime(env, "left/right key count mismatch");
+    return 0;
+  }
+  std::vector<jint> lk(nk), rk(nk);
+  env->GetIntArrayRegion(jlkeys, 0, nk, lk.data());
+  env->GetIntArrayRegion(jrkeys, 0, nk, rk.data());
+  uint64_t out = 0;
+  if (tpub_join(ctx.get(), (uint64_t)left, (uint64_t)right,
+                (const int32_t *)lk.data(), (const int32_t *)rk.data(),
+                (int32_t)nk, (int32_t)how, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_readParquetNative(
+    JNIEnv *env, jclass, jstring jpath, jobjectArray jcols) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  const char *path = env->GetStringUTFChars(jpath, nullptr);
+  std::vector<std::string> names;
+  std::vector<const char *> ptrs;
+  if (jcols) {
+    jsize n = env->GetArrayLength(jcols);
+    names.reserve((size_t)n);
+    for (jsize i = 0; i < n; i++) {
+      auto js = (jstring)env->GetObjectArrayElement(jcols, i);
+      const char *s = env->GetStringUTFChars(js, nullptr);
+      names.emplace_back(s);
+      env->ReleaseStringUTFChars(js, s);
+      env->DeleteLocalRef(js);
+    }
+    for (const auto &s : names) ptrs.push_back(s.c_str());
+  }
+  uint64_t out = 0;
+  int rc = tpub_read_parquet(ctx.get(), path,
+                             ptrs.empty() ? nullptr : ptrs.data(),
+                             (int32_t)ptrs.size(), &out);
+  env->ReleaseStringUTFChars(jpath, path);
+  if (rc != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
 JNIEXPORT void JNICALL
 Java_com_nvidia_spark_rapids_jni_TpuBridge_releaseNative(JNIEnv *env, jclass,
                                                          jlong handle) {

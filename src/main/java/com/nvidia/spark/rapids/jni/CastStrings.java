@@ -1,0 +1,49 @@
+/*
+ * CastStrings: STRING columns -> numeric columns with Spark cast semantics.
+ *
+ * Same public shape as the reference op class of the same name (grown in
+ * later reference revisions; the north-star op set names it): malformed
+ * input nulls the row (non-ANSI) or raises (ANSI), optional whitespace
+ * stripping, decimal casts honor (precision-free) scale.  Kernels are the
+ * device server's vectorized parsers (ops/cast_strings.py).
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class CastStrings {
+  private CastStrings() {}
+
+  /**
+   * Cast a STRING column to the numeric type named by a cudf-compatible
+   * type id (+ decimal scale).
+   *
+   * @param ansi  raise on malformed input instead of nulling the row
+   * @param strip trim whitespace before parsing
+   */
+  public static DeviceColumn cast(DeviceColumn column, int typeId, int scale,
+                                  boolean ansi, boolean strip) {
+    return new DeviceColumn(
+        castNative(column.getHandle(), typeId, scale, ansi, strip));
+  }
+
+  /** String -> INT64 (cudf type id 4). */
+  public static DeviceColumn toLong(DeviceColumn column, boolean ansi,
+                                    boolean strip) {
+    return cast(column, 4, 0, ansi, strip);
+  }
+
+  /** String -> FLOAT64 (cudf type id 10). */
+  public static DeviceColumn toDouble(DeviceColumn column, boolean ansi,
+                                      boolean strip) {
+    return cast(column, 10, 0, ansi, strip);
+  }
+
+  /** String -> DECIMAL64 at {@code scale} (cudf type id 26). */
+  public static DeviceColumn toDecimal64(DeviceColumn column, int scale,
+                                         boolean ansi, boolean strip) {
+    return cast(column, 26, scale, ansi, strip);
+  }
+
+  private static native long castNative(long columnHandle, int typeId,
+                                        int scale, boolean ansi,
+                                        boolean strip);
+}

@@ -1,0 +1,41 @@
+/*
+ * Hash: Spark-compatible hash functions over device tables.
+ *
+ * The Java face of the engine's Hash component (the reference grows the
+ * same class in later revisions backed by hash.cu; here the kernels are
+ * the device server's XLA integer programs — ops/hash.py).  Semantics are
+ * Spark's HashExpression: per-row chaining across columns, null columns
+ * pass the running seed through, type widening per Spark rules.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class Hash {
+  /** Spark's default seed for both hash() and xxhash64(). */
+  public static final int DEFAULT_SEED = 42;
+
+  private static final int KIND_MURMUR3 = 0;
+  private static final int KIND_XXHASH64 = 1;
+
+  private Hash() {}
+
+  /** Spark {@code hash(...)}: Murmur3_x86_32 -> one INT32 column. */
+  public static DeviceColumn murmurHash3_32(DeviceTable table, int seed) {
+    return new DeviceColumn(hashNative(table.getHandle(), KIND_MURMUR3, seed));
+  }
+
+  public static DeviceColumn murmurHash3_32(DeviceTable table) {
+    return murmurHash3_32(table, DEFAULT_SEED);
+  }
+
+  /** Spark {@code xxhash64(...)}: XXH64 -> one INT64 column. */
+  public static DeviceColumn xxhash64(DeviceTable table, int seed) {
+    return new DeviceColumn(hashNative(table.getHandle(), KIND_XXHASH64,
+                                       seed));
+  }
+
+  public static DeviceColumn xxhash64(DeviceTable table) {
+    return xxhash64(table, DEFAULT_SEED);
+  }
+
+  private static native long hashNative(long tableHandle, int kind, int seed);
+}

@@ -1,0 +1,86 @@
+/*
+ * TableOps: the relational surface over device-table handles.
+ *
+ * Plays the role ai.rapids.cudf.Table's methods play for the reference
+ * (groupBy/joins/readParquet — the cudf Java surface its pom grafts in,
+ * reference pom.xml:429-452): each call is handle-in/handle-out against
+ * the device server; bulk data never crosses.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public final class TableOps {
+  private TableOps() {}
+
+  // aggregation codes (bridge/protocol.py AGG_*)
+  public static final int AGG_SUM = 0;
+  public static final int AGG_COUNT = 1;
+  public static final int AGG_MIN = 2;
+  public static final int AGG_MAX = 3;
+  public static final int AGG_MEAN = 4;
+  public static final int AGG_COUNT_ALL = 5;
+  public static final int AGG_VAR = 6;
+  public static final int AGG_STD = 7;
+  public static final int AGG_SUMSQ = 8;
+
+  // join types (bridge/protocol.py JOIN_NAMES)
+  public static final int JOIN_INNER = 0;
+  public static final int JOIN_LEFT = 1;
+  public static final int JOIN_RIGHT = 2;
+  public static final int JOIN_FULL = 3;
+  public static final int JOIN_SEMI = 4;
+  public static final int JOIN_ANTI = 5;
+  public static final int JOIN_CROSS = 6;
+
+  /** One column of a table as a standalone device column handle. */
+  public static DeviceColumn getColumn(DeviceTable table, int index) {
+    return new DeviceColumn(getColumnNative(table.getHandle(), index));
+  }
+
+  /** Assemble device columns into a new device table. */
+  public static DeviceTable makeTable(DeviceColumn... columns) {
+    long[] handles = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      handles[i] = columns[i].getHandle();
+    }
+    return new DeviceTable(makeTableNative(handles));
+  }
+
+  /**
+   * GROUP BY {@code keyIndices} with per-column aggregations.  The result
+   * table holds the key columns first, then one column per aggregation.
+   */
+  public static DeviceTable groupBy(DeviceTable table, int[] keyIndices,
+                                    int[] aggColumns, int[] aggOps) {
+    return new DeviceTable(groupByNative(table.getHandle(), keyIndices,
+                                         aggColumns, aggOps));
+  }
+
+  /**
+   * Equi-join on {@code leftKeys}/{@code rightKeys} column indices.  The
+   * result holds the left columns then the right non-key columns
+   * (semi/anti: left columns only).
+   */
+  public static DeviceTable join(DeviceTable left, DeviceTable right,
+                                 int[] leftKeys, int[] rightKeys, int how) {
+    return new DeviceTable(joinNative(left.getHandle(), right.getHandle(),
+                                      leftKeys, rightKeys, how));
+  }
+
+  /** Scan a parquet file (path visible to the device server). */
+  public static DeviceTable readParquet(String path, String[] columns) {
+    return new DeviceTable(readParquetNative(path, columns));
+  }
+
+  public static DeviceTable readParquet(String path) {
+    return readParquet(path, null);
+  }
+
+  private static native long getColumnNative(long tableHandle, int index);
+  private static native long makeTableNative(long[] columnHandles);
+  private static native long groupByNative(long tableHandle, int[] keys,
+                                           int[] aggColumns, int[] aggOps);
+  private static native long joinNative(long leftHandle, long rightHandle,
+                                        int[] leftKeys, int[] rightKeys,
+                                        int how);
+  private static native long readParquetNative(String path, String[] columns);
+}
