@@ -492,10 +492,13 @@ float(np.asarray(okl)[0]); float(np.asarray(okr)[0])
 dt_x = time.perf_counter() - t0
 xbytes = sum(int(np.asarray(c.data).nbytes) for c in sl.columns) + \
          sum(int(np.asarray(c.data).nbytes) for c in sr.columns)
+# padding efficiency: live rows over padded exchange slots (VERDICT r4 #7)
+pad_eff = (nl + nr) / (sl.num_rows + sr.num_rows)
 print(json.dumps({{"dist_mrows_s": nl / dt_d / 1e6,
                    "local_mrows_s": nl / dt_l / 1e6,
                    "exchange_s": dt_x, "total_s": dt_d,
                    "exchange_MB": xbytes / 1e6,
+                   "padding_efficiency": pad_eff,
                    "rows_out": drows}}))
 """
     env = dict(os.environ,
@@ -604,7 +607,11 @@ def main():
                     "exchange": round(smj["exchange_s"], 3),
                     "join": round(smj["total_s"] - smj["exchange_s"], 3),
                     "total": round(smj["total_s"], 3)},
-                "exchange_MB": round(smj["exchange_MB"], 1)}}
+                "exchange_MB": round(smj["exchange_MB"], 1),
+                "padding_efficiency": {
+                    "value": round(smj["padding_efficiency"], 3),
+                    "note": "live rows / padded exchange slots (sent "
+                            "bytes over live bytes inverse)"}}}
                if smj else {}),
         },
     }))

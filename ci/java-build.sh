@@ -31,4 +31,21 @@ if ! jar tf "$JAR" | grep -q 'libtpubridge.*\.so$'; then
     jar tf "$JAR" >&2
     exit 1
 fi
-echo "java-build: OK ($(jar tf "$JAR" | grep -c '\.so$') native libs in jar)"
+
+# Persist the JUnit evidence as a named artifact (the "Java mile ran"
+# proof a JDK-less bench environment cannot produce): surefire XML +
+# build provenance land in target/java-mile/ for CI to upload.
+ART=target/java-mile
+rm -rf "$ART"   # stale XMLs must never pass as current evidence
+mkdir -p "$ART"
+cp -r target/surefire-reports "$ART"/ 2>/dev/null || true
+{
+    echo "date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    echo "jdk: $(javac -version 2>&1)"
+    echo "jar: $(basename "$JAR")"
+    echo "bridge_socket: ${TPU_BRIDGE_SOCKET:-<unset: JUnit bridge tests skipped>}"
+    grep -h -o 'tests="[0-9]*"[^>]*' "$ART"/surefire-reports/*.xml \
+        2>/dev/null || true
+} > "$ART"/SUMMARY.txt
+echo "java-build: OK ($(jar tf "$JAR" | grep -c '\.so$') native libs in jar;" \
+     "JUnit evidence in $ART)"

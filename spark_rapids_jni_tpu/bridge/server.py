@@ -282,17 +282,14 @@ class BridgeServer:
     def _op_cast_strings(self, payload: bytes) -> bytes:
         h, tid, scale, ansi, strip = struct.unpack_from("<QiiBB", payload)
         col = self._get_col(h)
-        from ..ops import cast_strings as cs
         dtype = DType(TypeId(tid), scale)
         if strip:
             from ..ops.strings import trim
             col = trim(col)
-        if dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
-            out = cs.cast_to_float(col, dtype, ansi=bool(ansi))
-        elif dtype.is_decimal:
-            out = cs.cast_to_decimal(col, dtype, ansi=bool(ansi))
-        else:
-            out = cs.cast_to_integer(col, dtype, ansi=bool(ansi))
+        # one dispatch owner: ops.cast.cast routes every string direction
+        # (integer/float/decimal/bool) with Spark semantics
+        from ..ops.cast import cast
+        out = cast(col, dtype, ansi=bool(ansi))
         return struct.pack("<Q", self.handles.put(out))
 
     def _op_groupby(self, payload: bytes) -> bytes:

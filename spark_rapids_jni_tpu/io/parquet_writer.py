@@ -165,6 +165,24 @@ def _schema_elements(table: Table, names, nullable, struct_fields) -> list:
                 elements.append(_leaf_element(
                     child, fns[fi], child.validity is not None))
             continue
+        if col.dtype.id == dt.TypeId.LIST:
+            child = col.children[0]
+            if child.dtype.id == dt.TypeId.LIST:
+                raise NotImplementedError(
+                    "parquet write supports one LIST level (the reader "
+                    "handles arbitrary depth; deeper writes TBD)")
+            # standard 3-level LIST: optional group (LIST) > repeated
+            # group list > element
+            elements.append([(3, T_I32, 1 if nl else 0),
+                             (4, T_BINARY, name),
+                             (5, T_I32, 1),
+                             (6, T_I32, 3)])          # ConvertedType LIST
+            elements.append([(3, T_I32, 2),           # REPEATED
+                             (4, T_BINARY, "list"),
+                             (5, T_I32, 1)])
+            elements.append(_leaf_element(
+                child, "element", child.validity is not None))
+            continue
         elements.append(_leaf_element(col, name, nl))
     return elements
 
@@ -218,6 +236,13 @@ def write_parquet(table: Table, path, compression: str = "snappy",
         for ci, c in enumerate(table.columns)
         if c.dtype.id == dt.TypeId.STRUCT
         for fi, ch in enumerate(c.children)}
+    # like field_nullable: snapshot LIST element nullability from the
+    # INPUT table — slicing materializes an all-true child validity, which
+    # must not add a definition level the schema doesn't declare
+    list_elem_nullable = {
+        ci: c.children[0].validity is not None
+        for ci, c in enumerate(table.columns)
+        if c.dtype.id == dt.TypeId.LIST}
     out = bytearray(_MAGIC)
     row_groups = []
     n = table.num_rows
@@ -232,9 +257,51 @@ def write_parquet(table: Table, path, compression: str = "snappy",
 
         # flatten to leaf chunks: a plain column is one leaf at path [name];
         # a STRUCT column is one leaf per field at path [name, f{i}], with
-        # 2-level definition levels when the struct itself is nullable
-        leaves = []  # (col path, leaf_col, max_def, levels, present_mask)
+        # 2-level definition levels when the struct itself is nullable; a
+        # LIST column is one leaf at [name, "list", "element"] with
+        # 3-level def levels and binary rep levels.  Leaf entries:
+        # (path, leaf_col, max_def, def_levels, present, rep_levels,
+        #  nvalues)
+        leaves = []
         for ci, (col, name) in enumerate(zip(part.columns, names)):
+            if col.dtype.id == dt.TypeId.LIST:
+                child = col.children[0]
+                opt_l = 1 if nullable[ci] else 0
+                opt_e = 1 if list_elem_nullable[ci] else 0
+                md = opt_l + 1 + opt_e
+                offs = np.asarray(col.offsets, np.int64)
+                lens = np.diff(offs)
+                lvalid = (np.ones(g_rows, np.bool_) if col.validity is None
+                          else np.asarray(col.validity))
+                lens_eff = np.where(lvalid, lens, 0)
+                counts = np.maximum(lens_eff, 1)       # 1 entry per empty/null
+                nvalues = int(counts.sum())
+                ent_start = np.cumsum(counts) - counts
+                row_of = np.repeat(np.arange(g_rows), counts)
+                first = np.zeros(nvalues, np.bool_)
+                first[ent_start] = True
+                rep = (~first).astype(np.uint8)
+                has_elem = np.repeat(lens_eff > 0, counts)
+                within = np.arange(nvalues) - np.repeat(ent_start, counts)
+                e_idx = np.repeat(offs[:-1], counts) + within
+                evalid_full = (np.asarray(child.validity)
+                               if opt_e and child.validity is not None
+                               else np.ones(child.size, np.bool_))
+                levels = np.zeros(nvalues, np.uint8)
+                lv_row = lvalid[row_of]
+                levels[lv_row & ~has_elem] = opt_l          # empty list
+                e_safe = np.clip(e_idx, 0, max(child.size - 1, 0))
+                full = opt_l + 1 + (
+                    evalid_full[e_safe] if opt_e else 0)
+                levels = np.where(has_elem, full, levels).astype(np.uint8)
+                # elements written: those of valid, non-empty rows, non-null
+                emask = np.zeros(child.size, np.bool_)
+                if nvalues:
+                    sel = e_idx[has_elem]
+                    emask[sel] = evalid_full[sel]
+                leaves.append(([name, "list", "element"], child, md,
+                               levels, emask, rep, nvalues))
+                continue
             if col.dtype.id == dt.TypeId.STRUCT:
                 s_opt = nullable[ci]
                 fns = _field_names(struct_fields, name, col)
@@ -253,20 +320,23 @@ def write_parquet(table: Table, path, compression: str = "snappy",
                         levels += svalid & fvalid
                     leaves.append(([name, fns[fi]], child, md,
                                    levels if md else None,
-                                   present if md else None))
+                                   present if md else None, None, g_rows))
             else:
                 if nullable[ci]:
                     valid = (np.ones(g_rows, np.bool_)
                              if col.validity is None
                              else np.asarray(col.validity))
                     leaves.append(([name], col, 1, valid.astype(np.uint8),
-                                   valid))
+                                   valid, None, g_rows))
                 else:
-                    leaves.append(([name], col, 0, None, None))
+                    leaves.append(([name], col, 0, None, None, None, g_rows))
 
-        for cpath, col, md, levels, present in leaves:
+        for cpath, col, md, levels, present, rep, nvalues in leaves:
             dtype = col.dtype
             body = b""
+            if rep is not None:  # V1 page: rep levels, then def levels
+                rv = _rle_levels(rep, 1)
+                body += len(rv).to_bytes(4, "little") + rv
             if md:
                 lv = _rle_levels(levels, md.bit_length())
                 body += len(lv).to_bytes(4, "little") + lv
@@ -274,8 +344,17 @@ def write_parquet(table: Table, path, compression: str = "snappy",
                 col, dtype, None if present is None else present)
             body += vals
             comp = codec.compress(body, asbytes=True) if codec else body
-            smin, smax, nulls = _stats(
-                col, dtype, None if present is None else present)
+            if rep is not None:
+                # list leaf: NULL entries are below the list-present level
+                # (an empty-but-valid list is exactly at it and is NOT
+                # null); min/max omitted
+                opt_l_here = md - 1 - (1 if list_elem_nullable[
+                    names.index(cpath[0])] else 0)
+                smin, smax, nulls = None, None, int(
+                    (levels < opt_l_here).sum())
+            else:
+                smin, smax, nulls = _stats(
+                    col, dtype, None if present is None else present)
             stats_fields = [(3, T_I64, nulls)]
             if smin is not None:
                 stats_fields += [(5, T_BINARY, smax), (6, T_BINARY, smin)]
@@ -284,7 +363,7 @@ def write_parquet(table: Table, path, compression: str = "snappy",
                 (2, T_I32, len(body)),
                 (3, T_I32, len(comp)),
                 (5, T_STRUCT, [                     # DataPageHeader
-                    (1, T_I32, g_rows),
+                    (1, T_I32, nvalues),
                     (2, T_I32, 0),                  # PLAIN
                     (3, T_I32, 3),                  # def levels RLE
                     (4, T_I32, 3),                  # rep levels RLE
@@ -299,7 +378,7 @@ def write_parquet(table: Table, path, compression: str = "snappy",
                 (2, T_LIST, (T_I32, [0, 3])),       # PLAIN, RLE
                 (3, T_LIST, (T_BINARY, list(cpath))),
                 (4, T_I32, codec_id),
-                (5, T_I64, g_rows),
+                (5, T_I64, nvalues),
                 (6, T_I64, len(header) + len(body)),
                 (7, T_I64, len(header) + len(comp)),
                 (9, T_I64, page_off),

@@ -71,10 +71,15 @@ def cast(col: Column, to: DType, ansi: bool = False) -> Column:
         raise NotImplementedError(f"cast STRING -> {to!r}")
     if to.is_string:
         from . import cast_strings as cs
-        if f.id in _INT_IDS:
+        if f.id in _INT_IDS or f.id == TypeId.BOOL8:
             return cs.cast_from_integer(col)
-        raise NotImplementedError(f"cast {f!r} -> STRING (only integral "
-                                  "sources format; others via host)")
+        if f.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return cs.cast_from_float(col)
+        if f.is_decimal:
+            return cs.cast_from_decimal(col)
+        if f.is_timestamp or f.id == TypeId.TIMESTAMP_DAYS:
+            return cs.cast_from_datetime(col)
+        raise NotImplementedError(f"cast {f!r} -> STRING")
 
     # ---- timestamps
     if f.is_timestamp and to.is_timestamp:
@@ -155,11 +160,94 @@ def _div_half_up(iv: jnp.ndarray, q) -> jnp.ndarray:
     return jnp.where(iv >= 0, m, -m)
 
 
+def _cast_decimal128(col: Column, to: DType) -> Column:
+    """Casts where either side is DECIMAL128: 128-bit limb arithmetic on
+    device (utils/int128 — the cudf fixed_point<__int128> role), Spark
+    non-ANSI overflow-to-null semantics throughout."""
+    from ..utils import int128 as i128
+    f = col.dtype
+    valid = col.valid_mask()
+    fs = f.scale if f.is_decimal else 0
+    ts = to.scale if to.is_decimal else 0
+
+    if f.id == TypeId.DECIMAL128:
+        lo, hi, neg = i128.split_sign(col.data[:, 0], col.data[:, 1])
+        ok = jnp.ones(neg.shape, jnp.bool_)
+    elif f.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        # Spark's float -> decimal goes through BigDecimal.valueOf, i.e.
+        # the SHORTEST decimal string of the double — so the unscaled
+        # value must come from the shortest digits (cast_from_float's
+        # machinery), rescaled EXACTLY in 128-bit integers, not from the
+        # value's full binary expansion
+        from .cast_strings import _shortest_digits
+        m, p, e, neg, nanm, infm, zerom = _shortest_digits(col)
+        lo, hi = i128.from_u64(m.astype(jnp.uint64))
+        k = e - (p - 1) - ts
+        ok = ~(nanm | infm) & (k <= 41)  # 10^41 overflows 2^127
+        lo, hi, ovf = i128.mul_pow10_dyn(
+            lo, hi, jnp.clip(k, 0, 41), 41)
+        ok = ok & (~ovf)
+        lo, hi = i128.div_pow10_dyn(
+            lo, hi, jnp.clip(-k, 0, 20), 20, half_up=True)
+        zlo = jnp.zeros(lo.shape, jnp.uint64)
+        lo = jnp.where(zerom, zlo, lo)
+        hi = jnp.where(zerom, zlo, hi)
+        neg = neg & (~zerom)
+        fs = ts  # already at target scale
+    else:
+        iv = _num_values(col).astype(jnp.int64)
+        neg = iv < 0
+        u = iv.astype(jnp.uint64)
+        mag = jnp.where(neg, jnp.uint64(0) - u, u)
+        lo, hi = i128.from_u64(mag)
+        ok = jnp.ones(neg.shape, jnp.bool_)
+
+    # value-preserving targets need no limb rescale: the scale factor
+    # applies in float space (float) or cancels (bool nonzero test)
+    if to.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        mf = i128.to_f64(lo, hi) * (10.0 ** fs)
+        vf = jnp.where(neg, -mf, mf)
+        return Column.fixed(to, vf.astype(
+            jnp.float32 if to.id == TypeId.FLOAT32 else jnp.float64),
+            validity=col.validity)
+    if to.id == TypeId.BOOL8:
+        return Column.fixed(to, (lo | hi) != 0, validity=col.validity)
+
+    diff = fs - ts
+    if not to.is_decimal:
+        diff = fs  # rescale all the way to integer units
+    if diff > 0:
+        lo, hi, ovf = i128.mul_pow10(lo, hi, diff)
+        ok = ok & (~ovf)
+    elif diff < 0:
+        # decimal targets round HALF_UP (Spark); integral targets truncate
+        lo, hi, _ = i128.div_pow10(lo, hi, -diff, half_up=to.is_decimal)
+
+    if to.id == TypeId.DECIMAL128:
+        ok = ok & i128.fits_bits(lo, hi, 127)
+        slo, shi = i128.apply_sign(lo, hi, neg)
+        data = jnp.stack([jnp.where(ok, slo, 0),
+                          jnp.where(ok, shi, 0)], axis=1)
+        return Column(to, data=data, validity=valid & ok)
+    if to.is_decimal:
+        bound = 2**31 - 1 if to.id == TypeId.DECIMAL32 else 2**62
+        ok = ok & i128.le_u64(lo, hi, bound)
+        slo, _ = i128.apply_sign(lo, hi, neg)
+        out = jnp.where(ok, slo, 0).astype(jnp.dtype(to.storage))
+        return Column(to, data=out, validity=valid & ok)
+    # integral targets: must fit int64 after the rescale, then narrow
+    ok = ok & i128.le_u64(lo, hi, 2**63)  # magnitude; 2^63 only when neg
+    ok = ok & ((lo < jnp.uint64(2**63)) | neg)
+    slo, _ = i128.apply_sign(lo, hi, neg)
+    return cast(Column.fixed(DType(TypeId.INT64),
+                             jnp.where(ok, slo, 0),
+                             validity=valid & ok), to)
+
+
 def _cast_decimal(col: Column, to: DType) -> Column:
     f = col.dtype
     if f.id == TypeId.DECIMAL128 or to.id == TypeId.DECIMAL128:
-        raise NotImplementedError("DECIMAL128 casts: use host-side "
-                                  "rescale (arbitrary precision)")
+        return _cast_decimal128(col, to)
     fs = f.scale if f.is_decimal else 0
     ts = to.scale if to.is_decimal else 0
     valid = col.valid_mask()

@@ -43,10 +43,64 @@ _I32 = jnp.int32
 _ACC_CAP = _U64((2**64 - 1 - 9) // 10)
 
 _POW10_U64 = jnp.asarray([10**k for k in range(20)], jnp.uint64)
-# f64 powers of ten, exact-to-double-rounding, index k -> 10^(k-350)
-_POW10_F64 = jnp.asarray(
-    np.array([float(f"1e{k}") for k in range(-350, 351)]),  # strtod: correctly
-    jnp.float64)                                            # rounded, inf/0 at ends
+# f64 powers of ten, exact-to-double-rounding, index k -> 10^(k-350).
+# The _NP tables are the source of truth and NEVER touch a device: on
+# TPU, pushing f64 constants through the emulated backend and pulling
+# them back CORRUPTS them (low bits + flushed subnormals).
+_POW10_F64_NP = np.array([float(f"1e{k}") for k in range(-350, 351)])
+_POW10_F64 = jnp.asarray(_POW10_F64_NP, jnp.float64)
+
+
+def _pow10_err_table():
+    """Exact residual (10^k - float(10^k)) per table entry, as float64 —
+    the correction term that lets cast_from_float evaluate decimal-vs-
+    binary deltas in double-double precision."""
+    from fractions import Fraction
+    errs = []
+    for k in range(-350, 351):
+        t = float(f"1e{k}")
+        if t == 0.0 or np.isinf(t):
+            errs.append(0.0)
+            continue
+        errs.append(float(Fraction(10) ** k - Fraction(t)))
+    return np.array(errs)
+
+
+_POW10_F64_ERR_NP = np.asarray(_pow10_err_table())
+_POW10_F64_ERR = jnp.asarray(_POW10_F64_ERR_NP, jnp.float64)
+
+# exact f64 powers of two, index e -> 2^(e-1100) (0 below the subnormal
+# floor, inf above the exponent cap); jnp.ldexp is NOT usable on TPU (it
+# lowers through a 64-bit bitcast, which the backend lacks)
+_POW2_F64_NP = np.array(
+    [0.0 if e < -1074 else (np.inf if e > 1023 else float(2.0 ** e))
+     for e in range(-1100, 1101)])
+_POW2_F64 = jnp.asarray(_POW2_F64_NP, jnp.float64)
+
+
+def _pow2(e):
+    return jnp.take(_POW2_F64, jnp.clip(e + 1100, 0, 2200))
+
+
+@functools.lru_cache(maxsize=1)
+def _f64_exact() -> bool:
+    """Does the default backend's float64 arithmetic round correctly?
+
+    TPU emulates f64 in software and its multiply is NOT correctly
+    rounded, which would silently break the exact half-ulp reasoning in
+    the shortest-digits search; when this probe fails, the search runs in
+    host numpy instead (these formatting casts materialize Arrow strings
+    at the host boundary anyway)."""
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal(128)
+    ys = rng.standard_normal(128) * np.power(
+        10.0, rng.integers(-18, 18, 128).astype(np.float64))
+    try:
+        mul = np.asarray(jnp.asarray(xs) * jnp.asarray(ys))
+        add = np.asarray(jnp.asarray(xs) + jnp.asarray(ys))
+    except Exception:
+        return False
+    return bool((mul == xs * ys).all() and (add == xs + ys).all())
 
 
 def _trim_bounds(mat, lengths):
@@ -351,4 +405,534 @@ def cast_from_integer(col: Column) -> Column:
         return from_padded_bytes(mat, lengths, col.validity)
     vals = jnp.asarray(col.data).astype(jnp.int64)
     mat, lengths = _int_to_digit_matrix(vals, 21)
+    return from_padded_bytes(mat, lengths, col.validity)
+
+
+# ---------------------------------------------------------------------------
+# device formatting casts (X -> STRING), VERDICT r4 missing #6
+# ---------------------------------------------------------------------------
+
+def _render_signed(body_char_at, body_len, neg, width: int):
+    """Assemble a char matrix from a per-position body renderer plus a sign.
+
+    ``body_char_at(i)`` gives the unsigned body's char at position i (from
+    the left); negatives shift the body right one slot for '-'.  Static
+    loop over ``width`` positions — pure elementwise device code.
+    """
+    n = body_len.shape[0]
+    out = jnp.zeros((n, width), jnp.uint8)
+    for i in range(width):
+        ch = jnp.where(i < body_len, body_char_at(i), jnp.uint8(0))
+        out = out.at[:, i].set(ch)
+    shifted = jnp.concatenate(
+        [jnp.full((n, 1), np.uint8(ord("-"))), out[:, :-1]], axis=1)
+    mat = jnp.where(neg[:, None], shifted, out)
+    return mat, body_len + neg.astype(_I32)
+
+
+def _decimal_body(digit_at, ndig, frac: int):
+    """Body renderer for a decimal magnitude: ``digit_at(j)`` is the digit
+    at index j counting from LEAST significant; ``frac`` (static) fraction
+    digits render as ``0.00x``-style zero-padded tails."""
+    show = jnp.maximum(ndig, frac + 1)
+    dot = 1 if frac > 0 else 0
+    int_digits = show - frac
+
+    def char_at(i):
+        j_int = show - 1 - i                       # before the dot
+        j_frac = show - 1 - (i - dot)              # after the dot
+        j = jnp.where(i < int_digits, j_int, j_frac)
+        d = digit_at(jnp.clip(j, 0, None))
+        ch = d.astype(jnp.uint8) + jnp.uint8(ord("0"))
+        if dot:
+            ch = jnp.where(i == int_digits, jnp.uint8(ord(".")), ch)
+        return ch
+
+    return char_at, show + dot
+
+
+def _mag_digits64(mag_u64):
+    """(digit_at, ndig) for uint64 magnitudes."""
+    ndig = jnp.ones(mag_u64.shape, _I32)
+    for k in range(1, 20):
+        ndig = jnp.where(mag_u64 >= jnp.take(_POW10_U64, k), k + 1, ndig)
+
+    def digit_at(j):
+        p10 = jnp.take(_POW10_U64, jnp.clip(j, 0, 19).astype(_I32))
+        d = ((mag_u64 // p10) % _U64(10)).astype(jnp.uint8)
+        return jnp.where(j > 19, jnp.uint8(0), d)  # beyond u64's 20 digits
+
+    return digit_at, ndig
+
+
+_CHUNK = 10**9  # 128-bit magnitudes decompose into five 9-digit chunks
+
+
+def _u128_chunks(lo_u64, hi_u64):
+    """uint128 (lo, hi) -> five base-1e9 chunks, most significant first.
+
+    Long division by 1e9 over four 32-bit limbs: each step's partial
+    dividend fits uint64 (r < 1e9 < 2^30, so r*2^32 + limb < 2^62) —
+    no 128-bit arithmetic anywhere, fully unrolled elementwise.
+    """
+    limbs = [  # most significant first
+        (hi_u64 >> _U64(32)) & _U64(0xFFFFFFFF),
+        hi_u64 & _U64(0xFFFFFFFF),
+        (lo_u64 >> _U64(32)) & _U64(0xFFFFFFFF),
+        lo_u64 & _U64(0xFFFFFFFF),
+    ]
+    chunks = []
+    for _ in range(5):
+        r = jnp.zeros(lo_u64.shape, _U64)
+        q = []
+        for d in limbs:
+            cur = (r << _U64(32)) | d
+            q.append(cur // _U64(_CHUNK))
+            r = cur % _U64(_CHUNK)
+        chunks.append(r)  # least significant chunk this round
+        limbs = q
+    return chunks[::-1]  # most significant first
+
+
+def _mag_digits128(lo_u64, hi_u64):
+    """(digit_at, ndig) for uint128 magnitudes (max 39 digits)."""
+    chunks = _u128_chunks(lo_u64, hi_u64)  # [c0..c4], c0 most significant
+    # first nonzero chunk wins: scan most-significant-first, keep the first
+    found = jnp.zeros(lo_u64.shape, jnp.bool_)
+    ndig = jnp.ones(lo_u64.shape, _I32)
+    for k, c in enumerate(chunks):
+        cd = jnp.ones(lo_u64.shape, _I32)
+        for t in range(1, 10):
+            cd = jnp.where(c >= jnp.take(_POW10_U64, t), t + 1, cd)
+        hit = (c > 0) & (~found)
+        ndig = jnp.where(hit, (4 - k) * 9 + cd, ndig)
+        found = found | (c > 0)
+
+    def digit_at(j):
+        # j//9 selects the chunk from the least-significant end; j is a
+        # TRACED array here, so gather the stacked chunks
+        stack = jnp.stack(chunks[::-1], axis=0)  # [c4..c0] least-sig first
+        ci = jnp.clip(j // 9, 0, 4).astype(_I32)
+        c = jnp.take_along_axis(stack, ci[None, :], axis=0)[0]
+        p10 = jnp.take(_POW10_U64, (j % 9).astype(_I32))
+        d = ((c // p10) % _U64(10)).astype(jnp.uint8)
+        return jnp.where(j >= 45, jnp.uint8(0), d)
+
+    return digit_at, ndig
+
+
+def _decimal128_parts(col: Column):
+    """(lo_u64, hi_u64 magnitude limbs, neg) from int64[n, 2] limb pairs."""
+    lo = col.data[:, 0].astype(jnp.uint64)
+    hi = col.data[:, 1].astype(jnp.uint64)
+    neg = col.data[:, 1] < 0
+    # two's-complement negate: ~x + 1 with carry lo -> hi
+    nlo = (~lo) + _U64(1)
+    nhi = (~hi) + jnp.where(nlo == 0, _U64(1), _U64(0))
+    return jnp.where(neg, nlo, lo), jnp.where(neg, nhi, hi), neg
+
+
+@traced("cast.from_decimal")
+def cast_from_decimal(col: Column) -> Column:
+    """DECIMAL32/64/128 -> STRING with Spark formatting: the unscaled value
+    at the type's scale, zero-padded fractions (``0.005``), trailing zeros
+    kept (scale is part of the type)."""
+    if not col.dtype.is_decimal:
+        raise TypeError(f"expected decimal column, got {col.dtype!r}")
+    scale = col.dtype.scale
+    frac = max(-scale, 0)
+    if col.dtype.id == TypeId.DECIMAL128:
+        lo, hi, neg = _decimal128_parts(col)
+        digit_at, ndig = _mag_digits128(lo, hi)
+        is_zero = (lo | hi) == 0
+        max_digits = 39
+    else:
+        vals = col.data.astype(jnp.int64)
+        neg = vals < 0
+        u = vals.astype(jnp.uint64)
+        mag = jnp.where(neg, _U64(0) - u, u)
+        digit_at, ndig = _mag_digits64(mag)
+        is_zero = mag == 0
+        max_digits = 19
+    if scale > 0:  # value = unscaled * 10^scale: trailing zeros
+        base_digit_at = digit_at
+
+        def digit_at(j):  # noqa: F811 — shifted view of the same digits
+            return jnp.where(j < scale, jnp.uint8(0),
+                             base_digit_at(jnp.maximum(j - scale, 0)))
+
+        # zero stays "0": trailing type-scale zeros apply to values only
+        ndig = jnp.where(is_zero, 1, ndig + scale)
+    char_at, body_len = _decimal_body(digit_at, ndig, frac)
+    width = max_digits + max(scale, 0) + frac + 3
+    mat, lengths = _render_signed(char_at, body_len, neg, width)
+    return from_padded_bytes(mat, lengths, col.validity)
+
+
+_NAN_LIT = np.frombuffer(b"NaN", np.uint8)
+_INF_LIT = np.frombuffer(b"Infinity", np.uint8)
+
+
+def _shortest_digits(col: Column):
+    """Shortest round-tripping decimal digits of a float column.
+
+    Returns (m, p, e10, neg, nanm, infm, zerom): per row the mantissa
+    digits as int64 (p digits), the decimal exponent (value ~
+    m * 10^(e10-p+1)), the sign, and the special masks.  The backbone of
+    BOTH the Java-style string rendering (cast_from_float) and Spark's
+    float -> decimal casts (BigDecimal.valueOf goes through the shortest
+    STRING, so the decimal must be built from these digits, not from the
+    exact binary expansion).
+
+    Backend dispatch: the search's half-ulp reasoning requires CORRECTLY
+    ROUNDED float64 +/-/* (Veltkamp two-products).  Where the backend has
+    it (CPU), the search runs on device; where f64 is sloppy software
+    emulation (TPU — see ``_f64_exact``), it runs in host numpy, which is
+    where these formatting casts materialize their Arrow strings anyway.
+    """
+    if col.dtype.id not in (TypeId.FLOAT32, TypeId.FLOAT64):
+        raise TypeError(f"expected float column, got {col.dtype!r}")
+    is32 = col.dtype.id == TypeId.FLOAT32
+    if _f64_exact():
+        v = col.float_values().astype(jnp.float64)
+        if is32:
+            bits = jax.lax.bitcast_convert_type(
+                jnp.asarray(col.data, jnp.float32), jnp.int32)
+        else:
+            bits = jnp.asarray(col.data)  # FLOAT64 stores bit patterns
+        return _shortest_digits_xp(jnp, v, bits, is32)
+    if is32:
+        host = np.asarray(col.data).astype(np.float32)
+        return _shortest_digits_xp(np, host.astype(np.float64),
+                                   host.view(np.int32), is32)
+    bits_np = np.asarray(col.data)
+    return _shortest_digits_xp(np, bits_np.view(np.float64), bits_np, is32)
+
+
+def _shortest_digits_xp(xp, v, bits, is32: bool):
+    """The search itself, over ``xp`` in {jnp, np} (identical APIs for
+    everything used here; bit manipulation arrives pre-bitcast)."""
+    maxp = 9 if is32 else 17
+    n = v.shape[0]
+    a = xp.abs(v)
+    nanm = xp.isnan(v)
+    infm = xp.isinf(v)
+    zerom = a == 0.0
+    neg = (bits < 0) & (~nanm)  # sign bit is the MSB of the bit pattern
+    safe_a = xp.where(nanm | infm | zerom, 1.0, a)
+
+    # powers of ten/two come from strtod-exact host tables, never
+    # xp.power (not correctly rounded even on CPU for some libms)
+    def t10(e):
+        return xp.take(_POW10_F64_NP, xp.clip(e + 350, 0, 700))
+
+    def t10err(e):
+        return xp.take(_POW10_F64_ERR_NP, xp.clip(e + 350, 0, 700))
+
+    # decimal exponent estimate + guarded corrections (log10 is inexact
+    # at boundaries; table entries underflow to 0 below 1e-323, so a zero
+    # power must never drive a correction)
+    e10 = xp.floor(xp.log10(safe_a)).astype(xp.int32)
+    for _ in range(2):
+        pe = t10(e10)
+        e10 = xp.where((pe > 0) & (safe_a < pe), e10 - 1, e10)
+    for _ in range(2):
+        pe = t10(e10 + 1)
+        e10 = xp.where((pe > 0) & (safe_a >= pe), e10 + 1, e10)
+    e10 = e10.astype(xp.int32)
+
+    def pow10_mul(x, k):
+        # x * 10^k with k possibly beyond double's exponent range: split
+        # into two in-range factors
+        k1 = xp.clip(k, -300, 300)
+        return x * t10(k1) * t10(k - k1)
+
+    # Rigorous acceptance predicate: the decimal m*10^k parses back to
+    # exactly this float iff |m*10^k - a| < ulp(a)/2.  The delta is
+    # evaluated in double-double precision (Veltkamp two-product — no FMA
+    # needed), with the exact residual of each table power, and the
+    # half-ulp comes from the BIT PATTERN, so a float-rounded
+    # reconstruction can never accept a decimal that strtod would snap to
+    # a neighboring double (the flaw of a recon == a test).
+    def two_prod(x, y):
+        c = xp.float64((1 << 27) + 1)
+        prod = x * y
+        xh = x * c - (x * c - x)
+        xl = x - xh
+        yh = y * c - (y * c - y)
+        yl = y - yh
+        err = ((xh * yh - prod) + xh * yl + xl * yh) + xl * yl
+        return prod, err
+
+    def dd_delta(m, k, aa):
+        # m*10^k - aa, with m < 2^57 split into exact f64 halves
+        mh = xp.floor_divide(m, xp.int64(1 << 26)).astype(xp.float64) \
+            * xp.float64(1 << 26)
+        ml = (m & xp.int64((1 << 26) - 1)).astype(xp.float64)
+        t = t10(k)
+        p1, er1 = two_prod(mh, t)
+        p2, er2 = two_prod(ml, t)
+        return ((p1 - aa) + p2) + (er1 + er2 + (mh + ml) * t10err(k))
+
+    if is32:
+        be = ((bits >> 23) & 0xFF).astype(xp.int32)
+        half_ulp = xp.take(_POW2_F64_NP, xp.clip(be - 151 + 1100, 0, 2200))
+    else:
+        be = ((bits >> 52) & 0x7FF).astype(xp.int32)
+        half_ulp = xp.take(_POW2_F64_NP, xp.clip(be - 1076 + 1100, 0, 2200))
+    margin = half_ulp * 0.99999
+
+    best_p = xp.full((n,), maxp, xp.int32)
+    best_m = xp.zeros((n,), xp.int64)
+    best_e = e10
+    found = xp.zeros((n,), bool)
+    for p in range(1, maxp + 1):
+        k = e10 - (p - 1)
+        t = t10(k)
+        deep = t <= 0.0  # table underflow (|value| ~< 1e-305): best-effort
+        m0 = xp.round(pow10_mul(safe_a, -k)).astype(xp.int64)
+        # one Newton step in mantissa units absorbs pow10_mul's rounding
+        adj = xp.where(deep, 0.0,
+                       xp.round(dd_delta(m0, k, safe_a) /
+                                xp.where(t > 0, t, 1.0))).astype(xp.int64)
+        m1 = m0 - adj
+        # of the three candidates, take the acceptable one with the
+        # SMALLEST delta — Java prints the decimal nearest the value when
+        # several p-digit decimals round-trip
+        sel_ok = xp.zeros((n,), bool)
+        sel_d = xp.full((n,), np.inf, xp.float64)
+        sel_m = xp.zeros((n,), xp.int64)
+        sel_bump = xp.zeros((n,), bool)
+        for c in (-1, 0, 1):
+            mc = m1 + c
+            bump = mc >= xp.int64(10 ** p)  # "9.99" rounds up to "10.0"
+            mcb = xp.where(bump, mc // 10, mc)
+            kc = xp.where(bump, k + 1, k)
+            lo_ok = mcb >= xp.int64(10 ** (p - 1)) if p > 1 else mcb >= 1
+            in_range = lo_ok & (mcb < xp.int64(10 ** p))
+            dabs = xp.abs(dd_delta(mcb, kc, safe_a))
+            okd = dabs < margin
+            okr = pow10_mul(mcb.astype(xp.float64), kc) == safe_a
+            ok = in_range & xp.where(deep, okr, okd)
+            better = ok & (dabs < sel_d)
+            sel_m = xp.where(better, mcb, sel_m)
+            sel_bump = xp.where(better, bump, sel_bump)
+            sel_d = xp.where(better, dabs, sel_d)
+            sel_ok = sel_ok | ok
+        hit = sel_ok & (~found)
+        best_p = xp.where(hit, p, best_p)
+        best_m = xp.where(hit, sel_m, best_m)
+        best_e = xp.where(hit, xp.where(sel_bump, e10 + 1, e10), best_e)
+        found = found | sel_ok
+    # nothing accepted (half-ulp ties, deep-subnormal scales): max precision
+    m17 = xp.round(pow10_mul(safe_a, -(e10 - (maxp - 1)))).astype(xp.int64)
+    bump = m17 >= xp.int64(10 ** maxp)
+    best_m = xp.where(found, best_m, xp.where(bump, m17 // 10, m17))
+    best_e = xp.where(found, best_e, xp.where(bump, e10 + 1, e10))
+    p_ = xp.where(found, best_p, maxp)
+    m_, e_ = best_m, best_e
+    # Java prints the shortest mantissa: strip trailing zeros
+    for _ in range(maxp - 1):
+        can = (m_ % 10 == 0) & (p_ > 1)
+        m_ = xp.where(can, m_ // 10, m_)
+        p_ = xp.where(can, p_ - 1, p_)
+    return m_, p_, e_, neg, nanm, infm, zerom
+
+
+@traced("cast.from_float")
+def cast_from_float(col: Column) -> Column:
+    """FLOAT32/64 -> STRING following Java Double/Float.toString: plain
+    decimal in [1e-3, 1e7), otherwise ``d.dddE±x`` scientific; the digit
+    count is the shortest that round-trips (searched 1..17 / 1..9,
+    verified against the half-ulp interval in double-double arithmetic).
+
+    Documented divergence (the reference plugin documents the same class
+    of difference behind spark.rapids.sql.castFloatToString.enabled):
+    half-ulp TIES and values below ~1e-305 (power-table underflow) may
+    print one more digit than Java — never a wrong value; every printed
+    string still parses back to the same float.  XLA flushes subnormals,
+    so sub-1e-308 doubles print "0.0" (the engine computes them as 0)."""
+    m_, p_, e_, neg, nanm, infm, zerom = _shortest_digits(col)
+    n = m_.shape[0]
+
+    def mdigit(j):  # mantissa digit j from least significant
+        p10 = jnp.take(_POW10_U64, jnp.clip(j, 0, 19).astype(_I32))
+        d = ((m_.astype(jnp.uint64) // p10) % _U64(10)).astype(jnp.uint8)
+        return jnp.where((j < 0) | (j > 19), jnp.uint8(0), d)
+
+    sci = (e_ >= 7) | (e_ < -3)
+    W = 28
+    zero8 = jnp.uint8(ord("0"))
+
+    # scientific body: [d][.][frac...][E][-][exp digits]
+    ae = jnp.abs(e_)
+    elen = 1 + (ae >= 10).astype(_I32) + (ae >= 100).astype(_I32)
+    esign = (e_ < 0).astype(_I32)
+    fp_sci = jnp.maximum(p_ - 1, 1)
+    len_sci = 2 + fp_sci + 1 + esign + elen
+
+    def sci_char(i):
+        ch = jnp.full((n,), zero8)
+        ch = jnp.where(i == 0, mdigit(p_ - 1) + zero8, ch)
+        if i == 1:
+            return jnp.full((n,), np.uint8(ord(".")))
+        if i >= 2:
+            t = i - 2
+            fr = jnp.where(p_ == 1, zero8, mdigit(p_ - 2 - t) + zero8)
+            ch = jnp.where(t < fp_sci, fr, ch)
+            epos = 2 + fp_sci
+            ch = jnp.where(i == epos, jnp.uint8(ord("E")), ch)
+            kk = i - epos - 1
+            ch = jnp.where((kk == 0) & (esign == 1) & (i > epos),
+                           jnp.uint8(ord("-")), ch)
+            ed = kk - esign  # exponent digit position from the left
+            digs = (ae.astype(jnp.int64) //
+                    jnp.take(_POW10_U64, jnp.clip(
+                        elen - 1 - ed, 0, 19).astype(_I32)).astype(jnp.int64)
+                    ) % 10
+            ch = jnp.where((i > epos) & (ed >= 0) & (ed < elen),
+                           digs.astype(jnp.uint8) + zero8, ch)
+        return ch
+
+    # plain body: [int digits][.][frac digits]
+    ilen = jnp.where(e_ >= 0, e_ + 1, 1)
+    zlead = jnp.maximum(-e_ - 1, 0)  # zeros after "0." for e10 < 0
+    fplain = jnp.where(e_ >= 0, jnp.maximum(p_ - (e_ + 1), 1), zlead + p_)
+    len_plain = ilen + 1 + fplain
+
+    def plain_char(i):
+        # integer part
+        jint = p_ - 1 - i
+        ich = jnp.where(e_ >= 0,
+                        jnp.where(jint >= 0, mdigit(jint) + zero8, zero8),
+                        zero8)
+        ch = ich
+        # dot
+        ch = jnp.where(i == ilen, jnp.uint8(ord(".")), ch)
+        # fraction
+        t = i - ilen - 1
+        jfrac_pos = p_ - 1 - (ilen + t)           # e10 >= 0
+        jfrac_neg = p_ - 1 - (t - zlead)          # e10 < 0
+        fch = jnp.where(
+            e_ >= 0,
+            jnp.where(jfrac_pos >= 0, mdigit(jfrac_pos) + zero8, zero8),
+            jnp.where(t < zlead, zero8, mdigit(jfrac_neg) + zero8))
+        return jnp.where(i > ilen, fch, ch)
+
+    body_len = jnp.where(sci, len_sci, len_plain)
+    mat, lengths = _render_signed(
+        lambda i: jnp.where(sci, sci_char(i), plain_char(i)),
+        body_len, neg, W)
+
+    # specials overlay: NaN / Infinity / -Infinity / 0.0 / -0.0
+    nanmat = jnp.zeros((W,), jnp.uint8).at[:3].set(jnp.asarray(_NAN_LIT))
+    infmat = jnp.zeros((W,), jnp.uint8).at[:8].set(jnp.asarray(_INF_LIT))
+    infneg = jnp.zeros((W,), jnp.uint8).at[0].set(
+        np.uint8(ord("-"))).at[1:9].set(jnp.asarray(_INF_LIT))
+    zmat = jnp.zeros((W,), jnp.uint8).at[0].set(zero8).at[1].set(
+        np.uint8(ord("."))).at[2].set(zero8)
+    zneg = jnp.zeros((W,), jnp.uint8).at[0].set(np.uint8(ord("-"))) \
+        .at[1].set(zero8).at[2].set(np.uint8(ord("."))).at[3].set(zero8)
+    mat = jnp.where(nanm[:, None], nanmat[None, :], mat)
+    lengths = jnp.where(nanm, 3, lengths)
+    mat = jnp.where((infm & ~neg)[:, None], infmat[None, :], mat)
+    lengths = jnp.where(infm & ~neg, 8, lengths)
+    mat = jnp.where((infm & neg)[:, None], infneg[None, :], mat)
+    lengths = jnp.where(infm & neg, 9, lengths)
+    mat = jnp.where((zerom & ~neg)[:, None], zmat[None, :], mat)
+    lengths = jnp.where(zerom & ~neg, 3, lengths)
+    mat = jnp.where((zerom & neg)[:, None], zneg[None, :], mat)
+    lengths = jnp.where(zerom & neg, 4, lengths)
+    return from_padded_bytes(mat, lengths, col.validity)
+
+
+@traced("cast.from_datetime")
+def cast_from_datetime(col: Column) -> Column:
+    """DATE/TIMESTAMP -> STRING with Spark CAST formatting:
+    ``yyyy-MM-dd`` for dates, ``yyyy-MM-dd HH:mm:ss[.ffffff]`` for
+    timestamps (fraction only when nonzero, trailing zeros stripped —
+    Spark's TimestampFormatter.getFractionFormatter behavior)."""
+    from .datetime import _days_and_secs, _civil
+    if not (col.dtype.is_timestamp or col.dtype.id == TypeId.TIMESTAMP_DAYS):
+        raise TypeError(f"expected date/timestamp column, got {col.dtype!r}")
+    is_date = col.dtype.id == TypeId.TIMESTAMP_DAYS
+    days, secs = _days_and_secs(col)
+    y, mo, d = _civil(days)
+    n = days.shape[0]
+    zero8 = jnp.uint8(ord("0"))
+
+    # sub-second micros (unit-dependent); _days_and_secs floors to seconds
+    unit = {TypeId.TIMESTAMP_SECONDS: 1,
+            TypeId.TIMESTAMP_MILLISECONDS: 10**3,
+            TypeId.TIMESTAMP_MICROSECONDS: 10**6,
+            TypeId.TIMESTAMP_NANOSECONDS: 10**9}.get(col.dtype.id, 1)
+    if unit > 1:
+        per_day = jnp.int64(86_400 * unit)
+        v = col.data.astype(jnp.int64)
+        tod = v - jnp.floor_divide(v, per_day) * per_day  # [0, per_day)
+        sub = tod % jnp.int64(unit)
+        micros = (sub * (10**6 // unit)).astype(jnp.int64) if unit <= 10**6 \
+            else jnp.floor_divide(sub, unit // 10**6)
+    else:
+        micros = jnp.zeros((n,), jnp.int64)
+
+    # fraction length: micros rendered to 6 digits, trailing zeros
+    # stripped — 6 minus the largest power of ten dividing micros
+    flen = jnp.full((n,), 6, _I32)
+    for t in range(1, 7):
+        flen = jnp.where(micros % jnp.int64(10 ** t) == 0, 6 - t, flen)
+    flen = jnp.where(micros == 0, 0, flen)
+
+    def two(x):  # 2-digit zero-padded pair of columns
+        return ((x // 10).astype(jnp.uint8) + zero8,
+                (x % 10).astype(jnp.uint8) + zero8)
+
+    cols = []
+    yy = y.astype(jnp.int64)
+    neg_y = yy < 0
+    ay = jnp.abs(yy)
+    # years render 4-digit zero-padded (Spark/proleptic; wider if >9999)
+    ylen = jnp.maximum(
+        4, jnp.where(ay >= 10000, 5, 4) + jnp.where(ay >= 100000, 1, 0))
+    W = 6 + 1 + 5 + (0 if is_date else 16)
+    out = jnp.zeros((n, W), jnp.uint8)
+    # year digits right-aligned in a 6-slot window, then shifted out below
+    ypos0 = 6 - ylen  # start of year digits in the fixed window
+    for i in range(6):
+        j = ylen - 1 - (i - ypos0)
+        p10 = jnp.take(_POW10_U64, jnp.clip(j, 0, 19).astype(_I32))
+        dch = ((ay.astype(jnp.uint64) // p10) % _U64(10)).astype(
+            jnp.uint8) + zero8
+        out = out.at[:, i].set(jnp.where(i >= ypos0, dch, jnp.uint8(0)))
+    rest = [np.uint8(ord("-")), *two(mo), np.uint8(ord("-")), *two(d)]
+    if not is_date:
+        hh = (secs // 3600).astype(jnp.int64)
+        mi = ((secs // 60) % 60).astype(jnp.int64)
+        ss = (secs % 60).astype(jnp.int64)
+        rest += [np.uint8(ord(" ")), *two(hh), np.uint8(ord(":")), *two(mi),
+                 np.uint8(ord(":")), *two(ss), np.uint8(ord("."))]
+        for k in range(6):
+            p10 = jnp.int64(10 ** (5 - k))
+            rest.append(((micros // p10) % 10).astype(jnp.uint8) + zero8)
+    for i, ch in enumerate(rest):
+        colv = jnp.broadcast_to(jnp.asarray(ch, jnp.uint8), (n,)) \
+            if np.isscalar(ch) or getattr(ch, "shape", ()) == () else ch
+        out = out.at[:, 6 + i].set(colv)
+    # compact the year's left padding: shift rows left by ypos0 slots
+    # (ylen in {4,5,6} -> ypos0 in {2,1,0}), then trim the tail: dates end
+    # after "-MM-dd"; timestamps keep ".f..." only when the fraction is
+    # nonzero, trailing zeros stripped
+    if is_date:
+        blen = ylen + 6
+    else:
+        blen = ylen + 15 + jnp.where(flen > 0, flen + 1, 0)
+    final = out
+    for shift in (1, 2):
+        shifted = jnp.concatenate(
+            [out[:, shift:], jnp.zeros((n, shift), jnp.uint8)], axis=1)
+        final = jnp.where((ypos0 == shift)[:, None], shifted, final)
+    # negative years: prepend '-'
+    mat, lengths = _render_signed(
+        lambda i: final[:, i] if i < W else jnp.zeros((n,), jnp.uint8),
+        blen, neg_y, W + 1)
     return from_padded_bytes(mat, lengths, col.validity)

@@ -1,0 +1,174 @@
+"""Unsigned 128-bit limb arithmetic as elementwise XLA integer programs.
+
+The device backbone of DECIMAL128 casts (the cudf fixed_point<__int128>
+role): values travel as (lo, hi) uint64 pairs, and every operation stays
+in 64-bit lanes — multiplication and division work over 32-bit limbs so no
+intermediate exceeds uint64 (TPU has no 128-bit, and no 64-bit bitcasts;
+see utils/floatbits.py for the same constraint on floats).
+
+All helpers are magnitude (unsigned) ops; callers split sign via
+``split_sign``/``apply_sign`` (two's-complement negate with carry).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_U64 = jnp.uint64
+_M32 = _U64(0xFFFFFFFF)
+
+
+def split_sign(lo_i64, hi_i64):
+    """int128 limb pairs -> (|x| lo, |x| hi, negative mask)."""
+    lo = lo_i64.astype(jnp.uint64)
+    hi = hi_i64.astype(jnp.uint64)
+    neg = hi_i64 < 0
+    nlo = (~lo) + _U64(1)
+    nhi = (~hi) + jnp.where(nlo == 0, _U64(1), _U64(0))
+    return jnp.where(neg, nlo, lo), jnp.where(neg, nhi, hi), neg
+
+
+def apply_sign(lo, hi, neg):
+    """(magnitude, neg) -> signed int64 limb pairs (two's complement)."""
+    nlo = (~lo) + _U64(1)
+    nhi = (~hi) + jnp.where(nlo == 0, _U64(1), _U64(0))
+    slo = jnp.where(neg, nlo, lo)
+    shi = jnp.where(neg, nhi, hi)
+    return slo.astype(jnp.int64), shi.astype(jnp.int64)
+
+
+def mul_small(lo, hi, c: int):
+    """(lo, hi) * c for 0 < c <= 2^30; returns (lo, hi, overflow)."""
+    assert 0 < c <= 1 << 30
+    cc = _U64(c)
+    limbs = [lo & _M32, lo >> _U64(32), hi & _M32, hi >> _U64(32)]
+    out = []
+    carry = jnp.zeros(lo.shape, _U64)
+    for d in limbs:
+        t = d * cc + carry          # < 2^32 * 2^30 + 2^62 < 2^63
+        out.append(t & _M32)
+        carry = t >> _U64(32)
+    nlo = out[0] | (out[1] << _U64(32))
+    nhi = out[2] | (out[3] << _U64(32))
+    return nlo, nhi, carry != 0
+
+
+def divmod_small(lo, hi, c: int):
+    """(lo, hi) // c and remainder, for 0 < c <= 2^30."""
+    assert 0 < c <= 1 << 30
+    cc = _U64(c)
+    limbs = [hi >> _U64(32), hi & _M32, lo >> _U64(32), lo & _M32]
+    q = []
+    r = jnp.zeros(lo.shape, _U64)
+    for d in limbs:                  # r < c <= 2^30, so cur < 2^62
+        cur = (r << _U64(32)) | d
+        q.append(cur // cc)
+        r = cur % cc
+    qhi = (q[0] << _U64(32)) | q[1]
+    qlo = (q[2] << _U64(32)) | q[3]
+    return qlo, qhi, r
+
+
+def mul_pow10(lo, hi, k: int):
+    """(lo, hi) * 10^k (k >= 0 static); returns (lo, hi, overflow)."""
+    ovf = jnp.zeros(lo.shape, jnp.bool_)
+    while k > 0:
+        step = min(k, 9)
+        lo, hi, o = mul_small(lo, hi, 10 ** step)
+        ovf = ovf | o
+        k -= step
+    return lo, hi, ovf
+
+
+def div_pow10(lo, hi, k: int, half_up: bool):
+    """(lo, hi) // 10^k (k > 0 static), truncating or HALF_UP (away from
+    zero on the magnitude); returns (lo, hi, exact)."""
+    exact = jnp.ones(lo.shape, jnp.bool_)
+    kk = k - 1 if half_up else k
+    while kk > 0:
+        step = min(kk, 9)
+        lo, hi, r = divmod_small(lo, hi, 10 ** step)
+        exact = exact & (r == 0)
+        kk -= step
+    if half_up:
+        lo, hi, d = divmod_small(lo, hi, 10)
+        exact = exact & (d == 0)
+        bump = d >= 5
+        nlo = lo + jnp.where(bump, _U64(1), _U64(0))
+        hi = hi + jnp.where(bump & (nlo == 0), _U64(1), _U64(0))
+        lo = nlo
+    return lo, hi, exact
+
+
+def add_small(lo, hi, c: int):
+    """(lo, hi) + c for small c >= 0; returns (lo, hi, carry_out)."""
+    nlo = lo + _U64(c)
+    carry = nlo < lo
+    nhi = hi + jnp.where(carry, _U64(1), _U64(0))
+    return nlo, nhi, carry & (nhi == 0)
+
+
+def fits_bits(lo, hi, bits: int):
+    """Magnitude < 2^bits (bits in (0, 128])."""
+    if bits >= 128:
+        return jnp.ones(lo.shape, jnp.bool_)
+    if bits > 64:
+        return hi < (_U64(1) << _U64(bits - 64))
+    if bits == 64:
+        return hi == 0
+    return (hi == 0) & (lo < (_U64(1) << _U64(bits)))
+
+
+def le_u64(lo, hi, bound: int):
+    """Magnitude <= bound (bound < 2^64)."""
+    return (hi == 0) & (lo <= _U64(bound))
+
+
+def to_f64(lo, hi):
+    """Magnitude as float64 (rounded — 128 bits exceed the mantissa)."""
+    return hi.astype(jnp.float64) * jnp.float64(2.0**64) + \
+        lo.astype(jnp.float64)
+
+
+def from_u64(mag_u64):
+    """uint64 magnitude -> (lo, hi)."""
+    return mag_u64, jnp.zeros(mag_u64.shape, _U64)
+
+
+def from_f64_mag(m):
+    """Nonnegative integer-valued float64 -> (lo, hi); exact because any
+    integral float64 is a 53-bit-mantissa multiple of a power of two."""
+    hif = jnp.floor(m * jnp.float64(2.0**-64))
+    lof = m - hif * jnp.float64(2.0**64)
+    return lof.astype(jnp.uint64), hif.astype(jnp.uint64)
+
+
+def mul_pow10_dyn(lo, hi, k, kmax: int):
+    """(lo, hi) * 10^k with PER-ROW k in [0, kmax] (static bound):
+    kmax masked multiply-by-ten steps; returns (lo, hi, overflow)."""
+    ovf = jnp.zeros(lo.shape, jnp.bool_)
+    for t in range(kmax):
+        nlo, nhi, o = mul_small(lo, hi, 10)
+        act = t < k
+        lo = jnp.where(act, nlo, lo)
+        hi = jnp.where(act, nhi, hi)
+        ovf = ovf | (act & o)
+    return lo, hi, ovf
+
+
+def div_pow10_dyn(lo, hi, k, kmax: int, half_up: bool):
+    """(lo, hi) // 10^k with PER-ROW k in [0, kmax]; HALF_UP uses the most
+    significant dropped digit (the remainder of the final step)."""
+    last = jnp.zeros(lo.shape, jnp.uint64)
+    for t in range(kmax):
+        nlo, nhi, r = divmod_small(lo, hi, 10)
+        act = t < k
+        last = jnp.where(act, r, last)
+        lo = jnp.where(act, nlo, lo)
+        hi = jnp.where(act, nhi, hi)
+    if half_up:
+        bump = (last >= 5) & (k > 0)
+        nlo = lo + jnp.where(bump, _U64(1), _U64(0))
+        hi = hi + jnp.where(bump & (nlo == 0), _U64(1), _U64(0))
+        lo = nlo
+    return lo, hi
