@@ -345,13 +345,11 @@ def write_parquet(table: Table, path, compression: str = "snappy",
             body += vals
             comp = codec.compress(body, asbytes=True) if codec else body
             if rep is not None:
-                # list leaf: NULL entries are below the list-present level
-                # (an empty-but-valid list is exactly at it and is NOT
-                # null); min/max omitted
-                opt_l_here = md - 1 - (1 if list_elem_nullable[
-                    names.index(cpath[0])] else 0)
-                smin, smax, nulls = None, None, int(
-                    (levels < opt_l_here).sum())
+                # list leaf: parquet-mr/arrow count every entry below
+                # max_def as a null at the leaf (null lists, null elements
+                # AND empty lists all lack a leaf value — verified against
+                # pyarrow's writer on identical data); min/max omitted
+                smin, smax, nulls = None, None, int((levels < md).sum())
             else:
                 smin, smax, nulls = _stats(
                     col, dtype, None if present is None else present)

@@ -469,28 +469,13 @@ _CHUNK = 10**9  # 128-bit magnitudes decompose into five 9-digit chunks
 
 
 def _u128_chunks(lo_u64, hi_u64):
-    """uint128 (lo, hi) -> five base-1e9 chunks, most significant first.
-
-    Long division by 1e9 over four 32-bit limbs: each step's partial
-    dividend fits uint64 (r < 1e9 < 2^30, so r*2^32 + limb < 2^62) —
-    no 128-bit arithmetic anywhere, fully unrolled elementwise.
-    """
-    limbs = [  # most significant first
-        (hi_u64 >> _U64(32)) & _U64(0xFFFFFFFF),
-        hi_u64 & _U64(0xFFFFFFFF),
-        (lo_u64 >> _U64(32)) & _U64(0xFFFFFFFF),
-        lo_u64 & _U64(0xFFFFFFFF),
-    ]
+    """uint128 (lo, hi) -> five base-1e9 chunks, most significant first
+    (utils.int128.divmod_small owns the limb long division)."""
+    from ..utils.int128 import divmod_small
     chunks = []
     for _ in range(5):
-        r = jnp.zeros(lo_u64.shape, _U64)
-        q = []
-        for d in limbs:
-            cur = (r << _U64(32)) | d
-            q.append(cur // _U64(_CHUNK))
-            r = cur % _U64(_CHUNK)
+        lo_u64, hi_u64, r = divmod_small(lo_u64, hi_u64, _CHUNK)
         chunks.append(r)  # least significant chunk this round
-        limbs = q
     return chunks[::-1]  # most significant first
 
 
@@ -522,14 +507,10 @@ def _mag_digits128(lo_u64, hi_u64):
 
 
 def _decimal128_parts(col: Column):
-    """(lo_u64, hi_u64 magnitude limbs, neg) from int64[n, 2] limb pairs."""
-    lo = col.data[:, 0].astype(jnp.uint64)
-    hi = col.data[:, 1].astype(jnp.uint64)
-    neg = col.data[:, 1] < 0
-    # two's-complement negate: ~x + 1 with carry lo -> hi
-    nlo = (~lo) + _U64(1)
-    nhi = (~hi) + jnp.where(nlo == 0, _U64(1), _U64(0))
-    return jnp.where(neg, nlo, lo), jnp.where(neg, nhi, hi), neg
+    """(lo_u64, hi_u64 magnitude limbs, neg) from int64[n, 2] limb pairs
+    (utils.int128.split_sign owns the negate-with-carry)."""
+    from ..utils.int128 import split_sign
+    return split_sign(col.data[:, 0], col.data[:, 1])
 
 
 @traced("cast.from_decimal")
@@ -891,14 +872,18 @@ def cast_from_datetime(col: Column) -> Column:
     yy = y.astype(jnp.int64)
     neg_y = yy < 0
     ay = jnp.abs(yy)
-    # years render 4-digit zero-padded (Spark/proleptic; wider if >9999)
-    ylen = jnp.maximum(
-        4, jnp.where(ay >= 10000, 5, 4) + jnp.where(ay >= 100000, 1, 0))
-    W = 6 + 1 + 5 + (0 if is_date else 16)
+    # years render 4-digit zero-padded (Spark/proleptic), widening up to
+    # 12 digits — TIMESTAMP_SECONDS over int64 reaches 12-digit years, and
+    # truncating high digits would print a silently wrong date
+    _YW = 12
+    ylen = jnp.full(ay.shape, 4, _I32)
+    for t in range(5, _YW + 1):
+        ylen = jnp.where(ay >= jnp.int64(10 ** (t - 1)), t, ylen)
+    W = _YW + 6 + (0 if is_date else 16)
     out = jnp.zeros((n, W), jnp.uint8)
-    # year digits right-aligned in a 6-slot window, then shifted out below
-    ypos0 = 6 - ylen  # start of year digits in the fixed window
-    for i in range(6):
+    # year digits right-aligned in the window, then shifted out below
+    ypos0 = _YW - ylen  # start of year digits in the fixed window
+    for i in range(_YW):
         j = ylen - 1 - (i - ypos0)
         p10 = jnp.take(_POW10_U64, jnp.clip(j, 0, 19).astype(_I32))
         dch = ((ay.astype(jnp.uint64) // p10) % _U64(10)).astype(
@@ -917,7 +902,7 @@ def cast_from_datetime(col: Column) -> Column:
     for i, ch in enumerate(rest):
         colv = jnp.broadcast_to(jnp.asarray(ch, jnp.uint8), (n,)) \
             if np.isscalar(ch) or getattr(ch, "shape", ()) == () else ch
-        out = out.at[:, 6 + i].set(colv)
+        out = out.at[:, _YW + i].set(colv)
     # compact the year's left padding: shift rows left by ypos0 slots
     # (ylen in {4,5,6} -> ypos0 in {2,1,0}), then trim the tail: dates end
     # after "-MM-dd"; timestamps keep ".f..." only when the fraction is
@@ -927,7 +912,7 @@ def cast_from_datetime(col: Column) -> Column:
     else:
         blen = ylen + 15 + jnp.where(flen > 0, flen + 1, 0)
     final = out
-    for shift in (1, 2):
+    for shift in range(1, _YW - 3):
         shifted = jnp.concatenate(
             [out[:, shift:], jnp.zeros((n, shift), jnp.uint8)], axis=1)
         final = jnp.where((ypos0 == shift)[:, None], shifted, final)

@@ -75,13 +75,20 @@ def rewrite(pattern: str):
     return ("contains", lit)
 
 
-def regex_matches(col: Column, pattern: str) -> Column:
-    """RLIKE via the rewrite table; raises for unsupported patterns."""
+def regex_matches(col: Column, pattern: str,
+                  fallback: bool = True) -> Column:
+    """RLIKE: the rewrite table's fast literal kernels when the pattern
+    lowers (the reference component's whole contract), else a host-side
+    regex escape hatch so NDS predicates outside the subset still run —
+    the analog of the plugin falling back to CPU for unsupported exprs.
+    ``fallback=False`` restores the strict reference behavior (raise)."""
     rw = rewrite(pattern)
     if rw is None:
-        raise ValueError(
-            f"pattern {pattern!r} is outside the rewritable subset "
-            "(literal prefix/suffix/contains/equals)")
+        if not fallback:
+            raise ValueError(
+                f"pattern {pattern!r} is outside the rewritable subset "
+                "(literal prefix/suffix/contains/equals)")
+        return _regex_matches_host(col, pattern)
     kind, lit = rw
     if kind == "startswith":
         return _s.starts_with(col, lit)
@@ -94,3 +101,30 @@ def regex_matches(col: Column, pattern: str) -> Column:
     import jax.numpy as jnp
     eq = (sw.data != 0) & (ln.data == len(lit.encode()))
     return Column(BOOL8, data=eq.astype(jnp.uint8), validity=sw.validity)
+
+
+def _regex_matches_host(col: Column, pattern: str) -> Column:
+    """Host-side RLIKE fallback (python `re` over the Arrow buffers).
+
+    Java regex and python `re` agree on the common NDS predicate shapes
+    (alternation, classes, quantifiers, anchors); exotic Java-only syntax
+    (possessive quantifiers, \\p{javaX}) still raises, loudly, from `re`.
+    RLIKE is an unanchored find(), matching Spark semantics.
+    """
+    import re
+    import numpy as np
+    import jax.numpy as jnp
+    rx = re.compile(pattern)
+    offs = np.asarray(col.offsets, np.int64)
+    chars = (np.asarray(col.data, np.uint8).tobytes()
+             if col.data is not None else b"")
+    n = offs.shape[0] - 1
+    hit = np.zeros(n, np.bool_)
+    valid = (np.ones(n, np.bool_) if col.validity is None
+             else np.asarray(col.validity))
+    for i in range(n):
+        if valid[i]:
+            s = chars[offs[i]:offs[i + 1]].decode("utf-8", "surrogatepass")
+            hit[i] = rx.search(s) is not None
+    return Column(BOOL8, data=jnp.asarray(hit.astype(np.uint8)),
+                  validity=None if col.validity is None else col.validity)

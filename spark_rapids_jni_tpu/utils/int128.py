@@ -100,14 +100,6 @@ def div_pow10(lo, hi, k: int, half_up: bool):
     return lo, hi, exact
 
 
-def add_small(lo, hi, c: int):
-    """(lo, hi) + c for small c >= 0; returns (lo, hi, carry_out)."""
-    nlo = lo + _U64(c)
-    carry = nlo < lo
-    nhi = hi + jnp.where(carry, _U64(1), _U64(0))
-    return nlo, nhi, carry & (nhi == 0)
-
-
 def fits_bits(lo, hi, bits: int):
     """Magnitude < 2^bits (bits in (0, 128])."""
     if bits >= 128:
@@ -133,14 +125,6 @@ def to_f64(lo, hi):
 def from_u64(mag_u64):
     """uint64 magnitude -> (lo, hi)."""
     return mag_u64, jnp.zeros(mag_u64.shape, _U64)
-
-
-def from_f64_mag(m):
-    """Nonnegative integer-valued float64 -> (lo, hi); exact because any
-    integral float64 is a 53-bit-mantissa multiple of a power of two."""
-    hif = jnp.floor(m * jnp.float64(2.0**-64))
-    lof = m - hif * jnp.float64(2.0**64)
-    return lof.astype(jnp.uint64), hif.astype(jnp.uint64)
 
 
 def mul_pow10_dyn(lo, hi, k, kmax: int):

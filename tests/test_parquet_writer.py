@@ -290,9 +290,17 @@ def test_list_write_multi_row_group(tmp_path):
     write_parquet(tbl, p, row_group_size=1024)
     assert pq.read_table(p).column("ls").to_pylist() == rows
     assert read_parquet(p).column("ls").to_pylist() == rows
-    # stats: empty-but-valid lists are NOT nulls
-    f = pq.ParquetFile(p)
-    for g in range(f.metadata.num_row_groups):
-        st = f.metadata.row_group(g).column(0).statistics
-        if st is not None:
-            assert st.null_count == 0
+    # stats follow the parquet-mr/arrow convention: every entry below
+    # max_def (null lists, null elements AND empty lists) counts as a
+    # leaf null — assert parity with a pyarrow-written file of the rows
+    import pyarrow as pa
+    p2 = str(tmp_path / "mrg_arrow.parquet")
+    pq.write_table(pa.table({"ls": rows}), p2, row_group_size=1024)
+    ours = pq.ParquetFile(p)
+    theirs = pq.ParquetFile(p2)
+    assert ours.metadata.num_row_groups == theirs.metadata.num_row_groups
+    for g in range(ours.metadata.num_row_groups):
+        st_o = ours.metadata.row_group(g).column(0).statistics
+        st_t = theirs.metadata.row_group(g).column(0).statistics
+        if st_o is not None and st_t is not None:
+            assert st_o.null_count == st_t.null_count

