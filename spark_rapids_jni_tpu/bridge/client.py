@@ -259,6 +259,26 @@ class BridgeClient:
         (h,) = struct.unpack("<Q", self._call(P.OP_JOIN, body))
         return h
 
+    def sort(self, table_handle: int, keys: list[tuple]) -> int:
+        """``keys``: (column index, ascending, nulls_first|None) tuples."""
+        body = struct.pack("<QI", table_handle, len(keys))
+        for ci, asc, nf in keys:
+            body += struct.pack("<IBB", ci, int(asc),
+                                2 if nf is None else int(nf))
+        (h,) = struct.unpack("<Q", self._call(P.OP_SORT, body))
+        return h
+
+    def filter(self, table_handle: int, mask_col_handle: int) -> int:
+        (h,) = struct.unpack("<Q", self._call(
+            P.OP_FILTER, struct.pack("<QQ", table_handle, mask_col_handle)))
+        return h
+
+    def concat(self, table_handles: list[int]) -> int:
+        body = struct.pack("<I", len(table_handles)) + b"".join(
+            struct.pack("<Q", h) for h in table_handles)
+        (h,) = struct.unpack("<Q", self._call(P.OP_CONCAT, body))
+        return h
+
     def read_parquet(self, path: str, columns: list[str] | None = None) -> int:
         pb = path.encode()
         body = struct.pack("<I", len(pb)) + pb

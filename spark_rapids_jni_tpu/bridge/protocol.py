@@ -60,6 +60,11 @@ OP_JOIN = 18           # [u64 lh][u64 rh][u8 how][u32 nk][u32 l...][u32 r...]
 #                        -> [u64 th]
 OP_READ_PARQUET = 19   # [u32 plen][path][u32 nc][(u32 len, name)...]
 #                        -> [u64 th]
+OP_SORT = 20           # [u64 th][u32 nk][(u32 idx, u8 asc,
+#                        u8 nulls: 0 last/1 first/2 spark-default)...]
+#                        -> [u64 th]
+OP_FILTER = 21         # [u64 th][u64 bool8 col] -> [u64 th]
+OP_CONCAT = 22         # [u32 n][u64 th...] -> [u64 th]
 
 # OP_GROUPBY aggregation codes
 AGG_SUM, AGG_COUNT, AGG_MIN, AGG_MAX, AGG_MEAN = 0, 1, 2, 3, 4

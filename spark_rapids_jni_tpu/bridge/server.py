@@ -351,6 +351,45 @@ class BridgeServer:
         out = read_parquet(path, columns=cols or None)
         return struct.pack("<Q", self.handles.put(out))
 
+    def _op_sort(self, payload: bytes) -> bytes:
+        h, nk = struct.unpack_from("<QI", payload)
+        off = 12
+        keys = []
+        for _ in range(nk):
+            ci, asc, nf = struct.unpack_from("<IBB", payload, off)
+            off += 6
+            keys.append((int(ci), bool(asc),
+                         None if nf == 2 else bool(nf)))
+        table = self._get_table(h)
+        from ..ops.order import SortKey, sort_indices
+        from ..ops.selection import gather_table
+        sk = [SortKey(table.columns[ci], ascending=asc, nulls_first=nf)
+              for ci, asc, nf in keys]
+        out = gather_table(table, sort_indices(sk))
+        return struct.pack("<Q", self.handles.put(out))
+
+    def _op_filter(self, payload: bytes) -> bytes:
+        h, mh = struct.unpack_from("<QQ", payload)
+        table = self._get_table(h)
+        mask = self._get_col(mh)
+        if mask.dtype.id != TypeId.BOOL8:
+            raise TypeError("filter mask must be a BOOL8 column")
+        if mask.size != table.num_rows:
+            raise ValueError(f"mask has {mask.size} rows, table "
+                             f"{table.num_rows}")
+        from ..ops.selection import gather_table, nonzero_indices
+        keep = (mask.data != 0) & mask.valid_mask()  # null -> dropped (SQL)
+        out = gather_table(table, nonzero_indices(keep))
+        return struct.pack("<Q", self.handles.put(out))
+
+    def _op_concat(self, payload: bytes) -> bytes:
+        (nt,) = struct.unpack_from("<I", payload)
+        tabs = [self._get_table(struct.unpack_from("<Q", payload,
+                                                   4 + 8 * i)[0])
+                for i in range(nt)]
+        from ..ops.selection import concat_tables
+        return struct.pack("<Q", self.handles.put(concat_tables(tabs)))
+
     # -- dispatch loop -----------------------------------------------------
     def _dispatch(self, opcode: int, payload: bytes) -> bytes:
         if opcode == P.OP_PING:
@@ -391,6 +430,12 @@ class BridgeServer:
             return self._op_join(payload)
         if opcode == P.OP_READ_PARQUET:
             return self._op_read_parquet(payload)
+        if opcode == P.OP_SORT:
+            return self._op_sort(payload)
+        if opcode == P.OP_FILTER:
+            return self._op_filter(payload)
+        if opcode == P.OP_CONCAT:
+            return self._op_concat(payload)
         raise ValueError(f"unknown opcode {opcode}")
 
     def _op_metrics(self) -> bytes:

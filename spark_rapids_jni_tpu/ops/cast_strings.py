@@ -904,7 +904,7 @@ def cast_from_datetime(col: Column) -> Column:
             if np.isscalar(ch) or getattr(ch, "shape", ()) == () else ch
         out = out.at[:, _YW + i].set(colv)
     # compact the year's left padding: shift rows left by ypos0 slots
-    # (ylen in {4,5,6} -> ypos0 in {2,1,0}), then trim the tail: dates end
+    # (ylen in 4..12 -> ypos0 in 8..0), then trim the tail: dates end
     # after "-MM-dd"; timestamps keep ".f..." only when the fraction is
     # nonzero, trailing zeros stripped
     if is_date:

@@ -114,7 +114,10 @@ def _regex_matches_host(col: Column, pattern: str) -> Column:
     import re
     import numpy as np
     import jax.numpy as jnp
-    rx = re.compile(pattern)
+    # re.ASCII: Java regex classes (\d \w \s \b) are ASCII by default —
+    # python defaults to Unicode classes, which would silently match e.g.
+    # Arabic-Indic digits that Spark's engine rejects
+    rx = re.compile(pattern, re.ASCII)
     offs = np.asarray(col.offsets, np.int64)
     chars = (np.asarray(col.data, np.uint8).tobytes()
              if col.data is not None else b"")

@@ -40,29 +40,38 @@ from ..utils.tracing import traced
 
 @functools.lru_cache(maxsize=32)
 def make_dest_ranks(mesh: Mesh, key_specs: tuple, axis: str = ROW_AXIS):
-    """Per-shard program: (datas, masks) -> (dest, rank within dest).
+    """Per-shard program: (datas, masks, n_valid) -> (rank within dest,
+    live mask).
 
     One stable 2-operand sort per shard, same formulation as the bucket
     pack; computed ONCE so every spill pass reuses the ranks instead of
-    re-sorting.
+    re-sorting.  Rows at global index >= n_valid are pad rows
+    (pad_to_multiple): they get live=False and never enter a pass window.
     """
     ndev = axis_size(mesh, axis)
 
-    def shard_fn(datas, masks):
+    def shard_fn(datas, masks, n_valid):
         cols = _spec_columns(key_specs, datas, masks)
         dest = partition_ids_specs(cols, key_specs, ndev)
         n = dest.shape[0]
+        shard_idx = jax.lax.axis_index(axis).astype(jnp.int64)
+        gid = shard_idx * n + jnp.arange(n, dtype=jnp.int64)
+        live = gid < n_valid
+        dest = jnp.where(live, dest, jnp.int32(ndev))  # pads rank last
         idx = jnp.arange(n, dtype=jnp.int32)
         sd, si = jax.lax.sort((dest, idx), num_keys=1, is_stable=True)
         first = jnp.concatenate([jnp.ones((1,), jnp.bool_), sd[1:] != sd[:-1]])
         run_start = jax.lax.cummax(jnp.where(first, idx, jnp.int32(-1)))
         srank = idx - run_start
         _, rank = jax.lax.sort((si, srank), num_keys=1, is_stable=True)
-        return dest, rank
+        return rank, live
 
     spec = P(axis)
-    return jax.jit(shard_map(shard_fn, mesh=mesh, in_specs=(spec, spec),
+    return jax.jit(shard_map(shard_fn, mesh=mesh, in_specs=(spec, spec, P()),
                              out_specs=(spec, spec), check_vma=False))
+
+
+_SPILL_SEQ = __import__("itertools").count(1)
 
 
 def _spill_buffers(schema, total_rows, spill_dir):
@@ -76,9 +85,13 @@ def _spill_buffers(schema, total_rows, spill_dir):
         if spill_dir is None:
             datas.append(np.empty(shape, npdt))
         else:
+            # unique per call: a fixed name would silently overwrite the
+            # buffers backing a still-live earlier spill result
             datas.append(np.lib.format.open_memmap(
-                os.path.join(spill_dir, f"spill-col{i}.npy"), mode="w+",
-                dtype=npdt, shape=shape))
+                os.path.join(spill_dir,
+                             f"spill-{os.getpid()}-{next(_SPILL_SEQ)}"
+                             f"-col{i}.npy"),
+                mode="w+", dtype=npdt, shape=shape))
         valids.append(np.ones(total_rows, np.bool_))
     return datas, valids
 
@@ -103,17 +116,18 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
         raise TypeError(
             "spilled shuffle is fixed-width only; dictionary-encode "
             "(ops/dictionary) or explode (parallel/stringplane) first")
-    from .mesh import shard_table
+    from .mesh import pad_to_multiple, shard_table
     ndev = axis_size(mesh, axis)
+    n_valid = table.num_rows
     if table.num_rows % ndev:
-        raise ValueError("pad the table to a mesh-divisible row count "
-                         "(parallel.mesh.pad_to_multiple) before spilling")
+        # pad internally with masked null rows (never sent, never output)
+        table, n_valid = pad_to_multiple(table, ndev)
     st = shard_table(table, mesh, axis)
     layout = fixed_width_layout(st.dtypes())
     key_specs = key_specs_for(st, keys, None)
 
     counts = partition_counts(st, mesh, list(keys), axis,
-                              key_specs=key_specs)
+                              n_valid_rows=n_valid, key_specs=key_specs)
     max_cap = int(counts.max())          # the one-shot capacity
     row_bytes = layout.row_size
     # per-pass capacity from the budget: a pass holds the received block
@@ -130,7 +144,7 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
     ranks_fn = make_dest_ranks(mesh, key_specs, axis)
     datas = tuple(c.data for c in st.columns)
     masks = tuple(c.validity for c in st.columns)
-    dest, rank = ranks_fn(datas, masks)
+    rank, live = ranks_fn(datas, masks, jnp.int64(n_valid))
 
     total = int(np.asarray(counts).sum())
     out_datas, out_valids = _spill_buffers(st.dtypes(), total, spill_dir)
@@ -138,7 +152,7 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
     written = 0
     for p in range(npasses):
         lo, hi = p * cap_slice, (p + 1) * cap_slice
-        window = (rank >= lo) & (rank < hi)
+        window = (rank >= lo) & (rank < hi) & live
         planes_in, ok, ovf = fn(datas, masks, window)
         if int(ovf):
             raise RuntimeError(f"spill pass {p} overflow ({int(ovf)} rows)"

@@ -151,6 +151,20 @@ int tpub_read_parquet(tpub_ctx *ctx, const char *path,
                       const char *const *columns, int32_t ncols,
                       uint64_t *out);
 
+/* ORDER BY key columns.  ascending[i] != 0 sorts ascending;
+ * nulls_first[i]: 0 last, 1 first, 2 Spark default (first iff asc). */
+int tpub_sort(tpub_ctx *ctx, uint64_t table, const int32_t *key_idx,
+              const int32_t *ascending, const int32_t *nulls_first,
+              int32_t nkeys, uint64_t *out);
+
+/* Keep rows whose BOOL8 mask entry is true (null mask rows drop — SQL). */
+int tpub_filter(tpub_ctx *ctx, uint64_t table, uint64_t mask_column,
+                uint64_t *out);
+
+/* Concatenate same-schema tables in order. */
+int tpub_concat(tpub_ctx *ctx, const uint64_t *tables, int32_t ntables,
+                uint64_t *out);
+
 /* lifecycle --------------------------------------------------------------- */
 int tpub_release(tpub_ctx *ctx, uint64_t handle);
 int tpub_live_count(tpub_ctx *ctx, int32_t *out);

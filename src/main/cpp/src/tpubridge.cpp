@@ -49,6 +49,9 @@ enum Op : uint8_t {
   OP_GROUPBY = 17,
   OP_JOIN = 18,
   OP_READ_PARQUET = 19,
+  OP_SORT = 20,
+  OP_FILTER = 21,
+  OP_CONCAT = 22,
 };
 
 constexpr uint8_t STATUS_OK = 0;
@@ -651,6 +654,36 @@ int tpub_read_parquet(tpub_ctx *ctx, const char *path,
     }
   }
   return call_handle_out(ctx, OP_READ_PARQUET, payload, out);
+}
+
+int tpub_sort(tpub_ctx *ctx, uint64_t table, const int32_t *key_idx,
+              const int32_t *ascending, const int32_t *nulls_first,
+              int32_t nkeys, uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, table);
+  put<uint32_t>(payload, (uint32_t)nkeys);
+  for (int32_t i = 0; i < nkeys; ++i) {
+    put<uint32_t>(payload, (uint32_t)key_idx[i]);
+    payload.push_back(ascending[i] ? 1 : 0);
+    payload.push_back((uint8_t)nulls_first[i]);
+  }
+  return call_handle_out(ctx, OP_SORT, payload, out);
+}
+
+int tpub_filter(tpub_ctx *ctx, uint64_t table, uint64_t mask_column,
+                uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint64_t>(payload, table);
+  put<uint64_t>(payload, mask_column);
+  return call_handle_out(ctx, OP_FILTER, payload, out);
+}
+
+int tpub_concat(tpub_ctx *ctx, const uint64_t *tables, int32_t ntables,
+                uint64_t *out) {
+  std::vector<uint8_t> payload;
+  put<uint32_t>(payload, (uint32_t)ntables);
+  for (int32_t i = 0; i < ntables; ++i) put<uint64_t>(payload, tables[i]);
+  return call_handle_out(ctx, OP_CONCAT, payload, out);
 }
 
 int tpub_release(tpub_ctx *ctx, uint64_t handle) {

@@ -381,6 +381,62 @@ Java_com_nvidia_spark_rapids_jni_TableOps_readParquetNative(
   return (jlong)out;
 }
 
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_sortNative(
+    JNIEnv *env, jclass, jlong table, jintArray jkeys, jintArray jasc,
+    jintArray jnf) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  jsize nk = env->GetArrayLength(jkeys);
+  if (env->GetArrayLength(jasc) != nk || env->GetArrayLength(jnf) != nk) {
+    throw_runtime(env, "sort key arrays length mismatch");
+    return 0;
+  }
+  std::vector<jint> keys(nk), asc(nk), nf(nk);
+  env->GetIntArrayRegion(jkeys, 0, nk, keys.data());
+  env->GetIntArrayRegion(jasc, 0, nk, asc.data());
+  env->GetIntArrayRegion(jnf, 0, nk, nf.data());
+  uint64_t out = 0;
+  if (tpub_sort(ctx.get(), (uint64_t)table, (const int32_t *)keys.data(),
+                (const int32_t *)asc.data(), (const int32_t *)nf.data(),
+                (int32_t)nk, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_filterNative(JNIEnv *env, jclass,
+                                                       jlong table,
+                                                       jlong mask) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  uint64_t out = 0;
+  if (tpub_filter(ctx.get(), (uint64_t)table, (uint64_t)mask, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_TableOps_concatNative(JNIEnv *env, jclass,
+                                                       jlongArray jtables) {
+  auto ctx = ctx_or_throw(env);
+  if (!ctx) return 0;
+  jsize n = env->GetArrayLength(jtables);
+  std::vector<jlong> tabs(n);
+  env->GetLongArrayRegion(jtables, 0, n, tabs.data());
+  std::vector<uint64_t> handles(tabs.begin(), tabs.end());
+  uint64_t out = 0;
+  if (tpub_concat(ctx.get(), handles.data(), (int32_t)n, &out) != 0) {
+    throw_runtime(env, tpub_last_error(ctx.get()));
+    return 0;
+  }
+  return (jlong)out;
+}
+
 JNIEXPORT void JNICALL
 Java_com_nvidia_spark_rapids_jni_TpuBridge_releaseNative(JNIEnv *env, jclass,
                                                          jlong handle) {

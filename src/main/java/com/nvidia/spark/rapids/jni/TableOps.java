@@ -75,6 +75,37 @@ public final class TableOps {
     return readParquet(path, null);
   }
 
+  // nullsFirst codes for orderBy
+  public static final int NULLS_LAST = 0;
+  public static final int NULLS_FIRST = 1;
+  public static final int NULLS_DEFAULT = 2; // Spark: first iff ascending
+
+  /** ORDER BY the given key columns. */
+  public static DeviceTable orderBy(DeviceTable table, int[] keyIndices,
+                                    boolean[] ascending, int[] nullsFirst) {
+    int[] asc = new int[ascending.length];
+    for (int i = 0; i < ascending.length; i++) {
+      asc[i] = ascending[i] ? 1 : 0;
+    }
+    return new DeviceTable(sortNative(table.getHandle(), keyIndices, asc,
+                                      nullsFirst));
+  }
+
+  /** Keep rows whose BOOL8 mask entry is true (null mask rows drop). */
+  public static DeviceTable filter(DeviceTable table, DeviceColumn mask) {
+    return new DeviceTable(filterNative(table.getHandle(),
+                                        mask.getHandle()));
+  }
+
+  /** Concatenate same-schema tables in order. */
+  public static DeviceTable concatenate(DeviceTable... tables) {
+    long[] handles = new long[tables.length];
+    for (int i = 0; i < tables.length; i++) {
+      handles[i] = tables[i].getHandle();
+    }
+    return new DeviceTable(concatNative(handles));
+  }
+
   private static native long getColumnNative(long tableHandle, int index);
   private static native long makeTableNative(long[] columnHandles);
   private static native long groupByNative(long tableHandle, int[] keys,
@@ -83,4 +114,8 @@ public final class TableOps {
                                         int[] leftKeys, int[] rightKeys,
                                         int how);
   private static native long readParquetNative(String path, String[] columns);
+  private static native long sortNative(long tableHandle, int[] keys,
+                                        int[] ascending, int[] nullsFirst);
+  private static native long filterNative(long tableHandle, long maskHandle);
+  private static native long concatNative(long[] tableHandles);
 }
