@@ -361,11 +361,11 @@ class BridgeServer:
             keys.append((int(ci), bool(asc),
                          None if nf == 2 else bool(nf)))
         table = self._get_table(h)
-        from ..ops.order import SortKey, sort_indices
-        from ..ops.selection import gather_table
-        sk = [SortKey(table.columns[ci], ascending=asc, nulls_first=nf)
-              for ci, asc, nf in keys]
-        out = gather_table(table, sort_indices(sk))
+        from ..ops.order import SortKey
+        from ..ops.selection import sort_table
+        out = sort_table(table, [SortKey(table.columns[ci], ascending=asc,
+                                         nulls_first=nf)
+                                 for ci, asc, nf in keys])
         return struct.pack("<Q", self.handles.put(out))
 
     def _op_filter(self, payload: bytes) -> bytes:
@@ -377,9 +377,8 @@ class BridgeServer:
         if mask.size != table.num_rows:
             raise ValueError(f"mask has {mask.size} rows, table "
                              f"{table.num_rows}")
-        from ..ops.selection import gather_table, nonzero_indices
-        keep = (mask.data != 0) & mask.valid_mask()  # null -> dropped (SQL)
-        out = gather_table(table, nonzero_indices(keep))
+        from ..ops.selection import apply_boolean_mask
+        out = apply_boolean_mask(table, mask)  # null mask rows drop (SQL)
         return struct.pack("<Q", self.handles.put(out))
 
     def _op_concat(self, payload: bytes) -> bytes:

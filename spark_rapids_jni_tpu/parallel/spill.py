@@ -22,7 +22,9 @@ string plane is exactly the buffer you do not want twice in memory.
 from __future__ import annotations
 
 import functools
+import itertools
 import os
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -71,7 +73,14 @@ def make_dest_ranks(mesh: Mesh, key_specs: tuple, axis: str = ROW_AXIS):
                              out_specs=(spec, spec), check_vma=False))
 
 
-_SPILL_SEQ = __import__("itertools").count(1)
+_SPILL_SEQ = itertools.count(1)
+
+
+def _unlink_quiet(path):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
 
 
 def _spill_buffers(schema, total_rows, spill_dir):
@@ -87,11 +96,15 @@ def _spill_buffers(schema, total_rows, spill_dir):
         else:
             # unique per call: a fixed name would silently overwrite the
             # buffers backing a still-live earlier spill result
-            datas.append(np.lib.format.open_memmap(
+            mm = np.lib.format.open_memmap(
                 os.path.join(spill_dir,
                              f"spill-{os.getpid()}-{next(_SPILL_SEQ)}"
                              f"-col{i}.npy"),
-                mode="w+", dtype=npdt, shape=shape))
+                mode="w+", dtype=npdt, shape=shape)
+            # reclaim disk when the buffer dies: unlink-while-mapped is
+            # safe on POSIX (views keep the base memmap alive)
+            weakref.finalize(mm, _unlink_quiet, mm.filename)
+            datas.append(mm)
         valids.append(np.ones(total_rows, np.bool_))
     return datas, valids
 
@@ -103,7 +116,8 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
                           axis: str = ROW_AXIS):
     """Shuffle by key hash with the device working set bounded by
     ``hbm_budget_bytes``; returns a HOST-resident compacted Table (numpy
-    buffers, or memmaps under ``spill_dir``).
+    buffers, or memmaps under ``spill_dir``, unlinked automatically when
+    the result is garbage-collected).
 
     Row placement is identical to ``shuffle_table_padded`` (Spark
     HashPartitioning); output rows appear pass-major, destination-shard

@@ -340,7 +340,8 @@ def test_bridge_sort_filter_concat(server):
     m = Table([Column.from_numpy(np.array([1, 0, 1, 1, 1], np.uint8),
                                  validity=np.array([1, 1, 1, 0, 1], bool),
                                  dtype=dt.BOOL8)])
-    mh = c.get_column(c.import_table(m), 0)
+    mth = c.import_table(m)
+    mh = c.get_column(mth, 0)
     fh = c.filter(th, mh)
     f = c.export_table(fh)
     np.testing.assert_array_equal(np.asarray(f.columns[1].data), [0, 2, 4])
@@ -348,6 +349,6 @@ def test_bridge_sort_filter_concat(server):
     ch = c.concat([th, th])
     nrows, _ = c.table_meta(ch)
     assert nrows == 10
-    for h in (th, sh, sh2, mh, fh, ch):
+    for h in (th, sh, sh2, mth, mh, fh, ch):
         c.release(h)
     c.close()

@@ -331,3 +331,54 @@ def test_q97_lite_matches_pandas(q97_warehouse):
     w_store, w_cat, w_both = q97_oracle(ss_df, cs_df)
     assert (store_only, catalog_only, both) == (w_store, w_cat, w_both)
 
+
+
+def test_q_predicate_cast_lite(tmp_path):
+    """An NDS-shaped plan over this round's new surface in one pipeline:
+    parquet scan -> RLIKE predicate outside the rewrite subset (host
+    escape hatch) -> decimal -> STRING formatting cast grouped by a
+    timestamp rendered as a date string; pandas is the oracle."""
+    from spark_rapids_jni_tpu.ops.cast import cast
+    from spark_rapids_jni_tpu.ops.regex_rewrite import regex_matches
+    from spark_rapids_jni_tpu import dtypes as dt
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.io import write_parquet
+
+    rng = np.random.default_rng(11)
+    n = 12_000
+    cats = np.array(["cat-1A", "cat-22B", "dog-3C", "cat-9", "fish-44D"],
+                    dtype=object)
+    category = cats[rng.integers(0, len(cats), n)]
+    amount_unscaled = rng.integers(-10**6, 10**6, n).astype(np.int64)
+    day = rng.integers(18000, 18010, n).astype(np.int32)  # epoch days
+    t = Table([
+        Column.from_pylist(list(category)),
+        Column.fixed(dt.decimal64(-2), amount_unscaled),
+        Column.fixed(dt.DType(dt.TypeId.TIMESTAMP_DAYS), day),
+    ], ["cat", "amt", "d"])
+    path = str(tmp_path / "fact.parquet")
+    write_parquet(t, path)
+    back = read_parquet(path)
+
+    # predicate: category RLIKE '^cat-\d+[A-Z]$' (outside the rewrite set)
+    hit = regex_matches(back.column("cat"), r"^cat-\d+[A-Z]$")
+    kept = apply_boolean_mask(back, Column(dt.BOOL8, data=hit.data,
+                                           validity=hit.validity))
+    # group by the date rendered as a string, sum the decimal
+    dstr = cast(kept.column("d"), dt.STRING)
+    g = groupby(Table([dstr, kept.column("amt")], ["ds", "amt"]),
+                ["ds"], [("amt", "sum")])
+
+    pdf = pd.DataFrame({"cat": category,
+                        "amt": amount_unscaled,
+                        "d": day})
+    pdf = pdf[pdf.cat.str.match(r"^cat-\d+[A-Z]$")]
+    import datetime
+    pdf["ds"] = pdf.d.map(
+        lambda x: (datetime.date(1970, 1, 1)
+                   + datetime.timedelta(days=int(x))).isoformat())
+    exp = pdf.groupby("ds").amt.sum()
+    got = dict(zip(g.column("ds").to_pylist(),
+                   np.asarray(g.column("sum_amt").data).tolist()))
+    assert len(got) == len(exp)
+    assert all(got[i] == s for i, s in exp.items())
