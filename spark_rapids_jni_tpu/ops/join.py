@@ -274,12 +274,17 @@ def inner_join(left: Table, right: Table, on_left, on_right=None,
 
 
 def inner_join_padded(left: Table, right: Table, on_left, on_right,
-                      capacity: int, left_live=None, right_live=None):
+                      capacity: int, left_live=None, right_live=None,
+                      pack: bool = True):
     """Fully jit-able inner join at a static pair capacity.
 
     Returns (li, ri, live, npairs, overflow): int32 pair indices padded to
     ``capacity`` with a live mask, the live pair count, and the count of
     candidate pairs that didn't fit (an upper bound on lost true pairs).
+    ``pack=False`` skips the front-packing compaction sort and returns the
+    pairs in candidate order with ``live`` as an arbitrary-position mask —
+    for callers that filter by mask anyway (the distributed join's host
+    compaction), the pack is a pure capacity-sized sort wasted.
     The building block for shard-local joins inside pjit/shard_map
     (distributed SortMergeJoin) where XLA needs static shapes — the
     role the 2^31-byte batch split plays in the reference
@@ -346,9 +351,11 @@ def inner_join_padded(left: Table, right: Table, on_left, on_right,
     # candidate pairs beyond capacity can't be equality-checked at static
     # shape; ``overflow`` (set per path above) is their count — a superset
     # bound on lost true pairs
+    npairs = jnp.sum(eq.astype(jnp.int32))
+    if not pack:
+        return li, ri, eq, npairs, overflow
     from .selection import nonzero_indices
     order = nonzero_indices(eq, count=capacity)
-    npairs = jnp.sum(eq.astype(jnp.int32))
     live = jnp.arange(capacity, dtype=jnp.int32) < npairs
     return (jnp.take(li, order), jnp.take(ri, order), live, npairs, overflow)
 

@@ -302,9 +302,11 @@ def build_distributed_join(mesh: Mesh, lschema: tuple, lnames: tuple,
                                      lmasks, on_left, lcap, lkey_specs)
         rtbl, rlive, rovf = exchange(rlayout, rnames, rschema, rdatas,
                                      rmasks, on_right, rcap, rkey_specs)
+        # pack=False: the host wrapper compacts by mask, so the
+        # front-packing compaction sort would be pure waste
         li, ri, jlive, npairs, jovf = inner_join_padded(
             ltbl, rtbl, list(on_left), list(on_right), jcap,
-            left_live=llive, right_live=rlive)
+            left_live=llive, right_live=rlive, pack=False)
 
         if how in ("inner", "left", "right", "full"):
             nl = ndev * lcap

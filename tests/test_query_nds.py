@@ -362,8 +362,7 @@ def test_q_predicate_cast_lite(tmp_path):
 
     # predicate: category RLIKE '^cat-\d+[A-Z]$' (outside the rewrite set)
     hit = regex_matches(back.column("cat"), r"^cat-\d+[A-Z]$")
-    kept = apply_boolean_mask(back, Column(dt.BOOL8, data=hit.data,
-                                           validity=hit.validity))
+    kept = apply_boolean_mask(back, hit)
     # group by the date rendered as a string, sum the decimal
     dstr = cast(kept.column("d"), dt.STRING)
     g = groupby(Table([dstr, kept.column("amt")], ["ds", "amt"]),
