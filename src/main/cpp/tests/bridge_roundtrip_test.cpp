@@ -225,14 +225,48 @@ int main(int argc, char **argv) {
   }
   tpub_free_export(&jx);
 
-  /* 5d. error discipline on the new ops: bad handle must error, not crash */
+  /* 5d. sort / filter / concat: the relational Table-surface ops */
+  uint64_t sres = 0;
+  int32_t skey[1] = {0};
+  int32_t sasc[1] = {0};        /* descending */
+  int32_t snf[1] = {2};         /* Spark default nulls placement */
+  CHECK_RC(ctx, tpub_sort(ctx, gtab, skey, sasc, snf, 1, &sres));
+  tpub_export sx{};
+  CHECK_RC(ctx, tpub_export_table(ctx, sres, &sx));
+  {
+    const auto *sk = (const int64_t *)sx.cols[0].data;
+    for (int64_t r = 1; r < 6; ++r)
+      CHECK(sk[r - 1] >= sk[r], "sort: row %" PRId64 " out of order", r);
+  }
+  tpub_free_export(&sx);
+
+  /* mask (k == 1): BOOL8 column via a 1-col imported table */
+  uint8_t mvals[6] = {1, 0, 1, 0, 1, 0};
+  tpub_col mcols[1] = {{11 /*BOOL8*/, 0, 6, mvals, 6, nullptr, nullptr}};
+  uint64_t mtab = 0, mcol = 0, fres = 0;
+  CHECK_RC(ctx, tpub_import_table(ctx, mcols, 1, &mtab));
+  CHECK_RC(ctx, tpub_get_column(ctx, mtab, 0, &mcol));
+  CHECK_RC(ctx, tpub_filter(ctx, gtab, mcol, &fres));
+  int32_t fcolsn = 0;
+  int64_t frows = 0;
+  CHECK_RC(ctx, tpub_table_meta(ctx, fres, &fcolsn, &frows));
+  CHECK(frows == 3, "filter kept %" PRId64 " rows, want 3", frows);
+
+  uint64_t cat_in[2] = {gtab, gtab};
+  uint64_t cres = 0;
+  CHECK_RC(ctx, tpub_concat(ctx, cat_in, 2, &cres));
+  int64_t crows = 0;
+  CHECK_RC(ctx, tpub_table_meta(ctx, cres, &fcolsn, &crows));
+  CHECK(crows == 12, "concat rows %" PRId64, crows);
+
+  /* 5e. error discipline on the new ops: bad handle must error, not crash */
   uint64_t dummy = 0;
   CHECK(tpub_hash(ctx, 999999, 0, 42, &dummy) != 0,
         "hash on a bad handle must fail");
   CHECK(std::strlen(tpub_last_error(ctx)) > 0, "error message empty");
 
   for (uint64_t h : {keycol, keytab, h1, h2, htab1, htab2, gtab, gres, dtab,
-                     jres})
+                     jres, sres, mtab, mcol, fres, cres})
     CHECK_RC(ctx, tpub_release(ctx, h));
 
   /* 6. close discipline: release everything, then leak-check */
