@@ -529,6 +529,104 @@ print(json.dumps({{"dist_mrows_s": nl / dt_d / 1e6,
         return None
 
 
+def bench_engine_q5(n=200_000):
+    """Whole-plan bridge dispatch vs per-op dispatch on a q5-lite shape.
+
+    The engine's reason to exist (docs/ENGINE.md): on an RTT-dominated link
+    every per-op call pays a round trip, so submitting the serialized plan
+    in ONE ``PLAN_EXECUTE`` message amortizes the link out of the plan walk.
+    Builds a tmpdir warehouse, runs scan+semi-join+agg+join+agg+sort both
+    ways against one server, and reports cold (plan-cache miss: optimize +
+    execute) vs warm (cache hit) plan dispatch plus the round-trip counts.
+    No pinned baseline yet: first round with the engine in the tree.
+    """
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
+    from spark_rapids_jni_tpu.bridge import protocol as P
+    from spark_rapids_jni_tpu.engine import Aggregate, Join, Scan, Sort
+
+    rng = np.random.default_rng(11)
+    with tempfile.TemporaryDirectory() as tmp:
+        root = os.path.join(tmp, "wh")
+        os.mkdir(root)
+        pq.write_table(pa.table({
+            "ss_sold_date_sk": pa.array(
+                np.sort(rng.integers(0, 400, n)).astype(np.int64)),
+            "ss_store_sk": pa.array(rng.integers(1, 13, n).astype(np.int64)),
+            "ss_ext_sales_price": pa.array(rng.uniform(0.5, 300.0, n)),
+        }), os.path.join(root, "store_sales.parquet"), row_group_size=20_000)
+        # the date filter is pre-applied at write time: the bridge's per-op
+        # surface has no comparison op, so both paths scan the kept range
+        pq.write_table(pa.table({
+            "d_date_sk": pa.array(np.arange(100, 300, dtype=np.int64)),
+        }), os.path.join(root, "date_dim.parquet"))
+        pq.write_table(pa.table({
+            "s_store_sk": pa.array(np.arange(1, 13, dtype=np.int64)),
+            "s_mgr": pa.array(np.arange(1, 13, dtype=np.int64) % 4),
+        }), os.path.join(root, "store.parquet"))
+
+        kept = Join(Scan(os.path.join(root, "store_sales.parquet")),
+                    Scan(os.path.join(root, "date_dim.parquet")),
+                    ["ss_sold_date_sk"], ["d_date_sk"], how="semi")
+        totals = Aggregate(kept, ["ss_store_sk"],
+                           [("ss_ext_sales_price", "sum"),
+                            ("ss_ext_sales_price", "count")],
+                           names=["sales", "n"])
+        joined = Join(totals, Scan(os.path.join(root, "store.parquet")),
+                      ["ss_store_sk"], ["s_store_sk"], how="inner")
+        plan = Sort(Aggregate(joined, ["s_mgr"],
+                              [("sales", "sum"), ("n", "sum")],
+                              names=["sales", "n"]),
+                    (("s_mgr", True),))
+
+        sock = os.path.join(tmp, "tpub.sock")
+        proc = spawn_server(sock)
+        try:
+            c = BridgeClient(sock)
+            t0 = time.perf_counter()
+            h_cold = c.execute_plan(plan)
+            t_cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            h_warm = c.execute_plan(plan)
+            t_warm = time.perf_counter() - t0
+            plan_trips = 1  # each execute_plan was one _call
+
+            before = c.round_trips
+            t0 = time.perf_counter()
+            sh = c.read_parquet(os.path.join(root, "store_sales.parquet"))
+            dh = c.read_parquet(os.path.join(root, "date_dim.parquet"))
+            th = c.read_parquet(os.path.join(root, "store.parquet"))
+            kh = c.join(sh, dh, [0], [0], "semi")
+            gh = c.groupby(kh, [1], [(2, P.AGG_SUM), (2, P.AGG_COUNT)])
+            jh = c.join(gh, th, [0], [0], "inner")
+            g2 = c.groupby(jh, [3], [(1, P.AGG_SUM), (2, P.AGG_SUM)])
+            oh = c.sort(g2, [(0, True, None)])
+            t_perop = time.perf_counter() - t0
+            perop_trips = c.round_trips - before
+
+            got = c.export_table(h_warm[0])
+            want = c.export_table(oh)
+            same = got.num_rows == want.num_rows and all(
+                np.allclose(np.asarray(a.data), np.asarray(b.data))
+                for a, b in zip(got.columns, want.columns))
+            cache = c.metrics()["plan_cache"]
+            c.shutdown_server()
+        except Exception as e:
+            print(f"engine bench failed: {e!r}", file=sys.stderr)
+            proc.kill()
+            return None
+        finally:
+            proc.wait(timeout=30)
+    return {"cold_ms": t_cold * 1e3, "warm_ms": t_warm * 1e3,
+            "per_op_ms": t_perop * 1e3, "plan_round_trips": plan_trips,
+            "per_op_round_trips": perop_trips, "results_match": same,
+            "cache_hits": cache["hits"], "cache_misses": cache["misses"]}
+
+
 def main():
     import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
 
@@ -540,6 +638,7 @@ def main():
         bench_parquet_scan()
     win_dev, win_cpu = bench_window()
     smj = bench_distributed_join()
+    eng = bench_engine_q5()
 
     # vs_baseline is measured/PINNED (BENCH_BASELINES.json), so the ratio is
     # comparable across rounds; the live re-measure of each baseline is
@@ -621,6 +720,21 @@ def main():
                     "note": "live rows / padded exchange slots (sent "
                             "bytes over live bytes inverse)"}}}
                if smj else {}),
+            **({"engine_q5_plan_execute": {
+                "cold_ms": round(eng["cold_ms"], 1),
+                "warm_ms": round(eng["warm_ms"], 1),
+                "per_op_dispatch_ms": round(eng["per_op_ms"], 1),
+                "round_trips": {"plan": eng["plan_round_trips"],
+                                "per_op": eng["per_op_round_trips"]},
+                "plan_cache": {"hits": eng["cache_hits"],
+                               "misses": eng["cache_misses"]},
+                "results_match": eng["results_match"],
+                "note": "q5-lite via ONE PLAN_EXECUTE message (cold = "
+                        "plan-cache miss: optimize+execute; warm = cache "
+                        "hit) vs the same query as per-op bridge calls; "
+                        "no pinned baseline yet (first round with the "
+                        "engine in the tree)"}}
+               if eng else {}),
         },
     }))
 

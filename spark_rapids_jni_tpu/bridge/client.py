@@ -68,9 +68,13 @@ class BridgeClient:
     def __init__(self, sock_path: str):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(sock_path)
+        # every request/reply exchange; whole-plan dispatch exists to keep
+        # this flat where per-op traffic grows with plan size
+        self.round_trips = 0
 
     # -- plumbing ----------------------------------------------------------
     def _call(self, opcode: int, payload: bytes = b"") -> bytes:
+        self.round_trips += 1
         P.send_msg(self.sock, opcode, payload)
         status, body = P.recv_msg(self.sock)
         if status != P.STATUS_OK:
@@ -289,3 +293,18 @@ class BridgeClient:
             body += struct.pack("<I", len(cb)) + cb
         (h,) = struct.unpack("<Q", self._call(P.OP_READ_PARQUET, body))
         return h
+
+    def execute_plan(self, plan) -> list[int]:
+        """Run a whole engine plan in ONE round-trip; returns table handles.
+
+        ``plan`` is an ``engine.PlanNode`` or already-serialized plan bytes.
+        The server optimizes through its plan cache, executes, and replies
+        with the result handle(s) — versus one ``_call`` per op for the
+        same pipeline built from read_parquet/join/groupby/sort.
+        """
+        blob = bytes(plan) if isinstance(plan, (bytes, bytearray)) \
+            else plan.serialize()
+        body = self._call(P.OP_PLAN_EXECUTE,
+                          struct.pack("<I", len(blob)) + blob)
+        (n,) = struct.unpack_from("<I", body)
+        return list(struct.unpack_from(f"<{n}Q", body, 4))

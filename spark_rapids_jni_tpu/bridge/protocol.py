@@ -65,6 +65,11 @@ OP_SORT = 20           # [u64 th][u32 nk][(u32 idx, u8 asc,
 #                        -> [u64 th]
 OP_FILTER = 21         # [u64 th][u64 bool8 col] -> [u64 th]
 OP_CONCAT = 22         # [u32 n][u64 th...] -> [u64 th]
+OP_PLAN_EXECUTE = 23   # [u32 plen][plan json utf-8] -> [u32 n][u64 th...]
+#                        whole-plan dispatch: one round-trip submits a
+#                        serialized engine plan DAG (engine/plan.py
+#                        canonical JSON); the server optimizes/caches/
+#                        executes it and returns result table handle(s)
 
 # OP_GROUPBY aggregation codes
 AGG_SUM, AGG_COUNT, AGG_MIN, AGG_MAX, AGG_MEAN = 0, 1, 2, 3, 4

@@ -135,6 +135,9 @@ class BridgeServer:
         # observability (SURVEY §5 metrics/logging): per-op counters the
         # client reads over OP_METRICS; slf4j-analog logger from utils.config
         self._metrics = {"ops": {}, "errors": 0, "busy_s": 0.0}
+        # lazily built on the first PLAN_EXECUTE (imports the engine)
+        self._plan_cache = None
+        self._last_plan_stats: dict = {}
         from ..utils.config import logger
         self._log = logger()
 
@@ -389,6 +392,30 @@ class BridgeServer:
         from ..ops.selection import concat_tables
         return struct.pack("<Q", self.handles.put(concat_tables(tabs)))
 
+    def _op_plan_execute(self, payload: bytes) -> bytes:
+        """Whole-plan dispatch: one message runs a multi-op plan DAG.
+
+        The serve-heavy-traffic counterpart to the per-op methods above:
+        instead of N round-trips the client ships one serialized logical
+        plan; the server-side ``PlanCache`` optimizes it once per
+        fingerprint (hits skip optimization AND reuse warm jit caches) and
+        the executor runs it against local io/ops.  Result table handles
+        come back in the one reply.
+        """
+        (plen,) = struct.unpack_from("<I", payload)
+        blob = payload[4:4 + plen]
+        from ..engine import deserialize
+        plan = deserialize(blob)
+        if self._plan_cache is None:
+            from ..engine import PlanCache
+            self._plan_cache = PlanCache()
+        compiled = self._plan_cache.get(plan)
+        stats: dict = {}
+        out = compiled.execute(stats=stats)
+        self._last_plan_stats = stats
+        h = self.handles.put(out)
+        return struct.pack("<I", 1) + struct.pack("<Q", h)
+
     # -- dispatch loop -----------------------------------------------------
     def _dispatch(self, opcode: int, payload: bytes) -> bytes:
         if opcode == P.OP_PING:
@@ -435,6 +462,8 @@ class BridgeServer:
             return self._op_filter(payload)
         if opcode == P.OP_CONCAT:
             return self._op_concat(payload)
+        if opcode == P.OP_PLAN_EXECUTE:
+            return self._op_plan_execute(payload)
         raise ValueError(f"unknown opcode {opcode}")
 
     def _op_metrics(self) -> bytes:
@@ -444,6 +473,9 @@ class BridgeServer:
                 "busy_s": round(self._metrics["busy_s"], 6),
                 "live_handles": self.handles.live_count(),
                 "open_exports": len(self._exports)}
+        if self._plan_cache is not None:
+            snap["plan_cache"] = self._plan_cache.stats()
+            snap["last_plan"] = dict(self._last_plan_stats)
         return json.dumps(snap).encode()
 
     def serve_forever(self) -> None:

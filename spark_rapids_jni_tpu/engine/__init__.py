@@ -1,0 +1,30 @@
+"""Query-plan engine: logical plan DAG, optimizer, executor, plan cache.
+
+The layer Spark plays for the reference repo, grown natively: build a
+``Scan/Filter/Project/Join/Aggregate/Sort/Limit`` DAG (plan.py), let
+``optimize`` prune projections and push predicates into scan row-group
+pruning (optimizer.py), then ``execute`` it on the ops/io layers with
+streaming per-chunk partial aggregation (executor.py) — or go through
+``PlanCache`` (cache.py) so repeat queries skip optimization and hit warm
+jit caches.  ``docs/ENGINE.md`` has the full design, including the bridge's
+one-message ``PLAN_EXECUTE`` wire format.
+"""
+
+from .plan import (  # noqa: F401
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    PlanNode,
+    Project,
+    Scan,
+    Sort,
+    col,
+    deserialize,
+    expr_columns,
+    from_dict,
+    lit,
+)
+from .optimizer import optimize, output_names  # noqa: F401
+from .executor import execute, new_stats  # noqa: F401
+from .cache import CompiledPlan, PlanCache  # noqa: F401

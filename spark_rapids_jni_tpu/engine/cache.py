@@ -1,0 +1,92 @@
+"""Plan cache: fingerprint-keyed, optimized-once, jit-warm compiled plans.
+
+The "serve heavy traffic" lever: a repeated query (same plan structure, new
+execution) must not pay optimization again, and — because every op kernel
+underneath is ``jax.jit``-compiled with shape-keyed caches — re-executing
+the same optimized plan on same-shaped data hits XLA's dispatch caches
+instead of recompiling.  ``PlanCache.get`` returns a ``CompiledPlan`` whose
+first ``execute`` warms those jit caches; subsequent executes are dispatch-
+only.  Hit/miss counts flow through ``utils.tracing`` counters
+(``engine.plan_cache.hit`` / ``.miss``) and ``stats()`` for the bridge's
+METRICS payload.
+
+The key is the fingerprint of the *unoptimized* serialized plan: clients
+submit logical plans, so two structurally identical submissions must hit
+regardless of what the optimizer does to them.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ..utils import tracing
+from .executor import execute
+from .optimizer import optimize
+from .plan import PlanNode
+
+
+class CompiledPlan:
+    """An optimized plan plus its execution entry point."""
+
+    __slots__ = ("key", "plan", "optimized", "executions")
+
+    def __init__(self, key: str, plan: PlanNode, optimized: PlanNode):
+        self.key = key
+        self.plan = plan
+        self.optimized = optimized
+        self.executions = 0
+
+    def execute(self, stats: Optional[dict] = None):
+        self.executions += 1
+        return execute(self.optimized, stats=stats)
+
+
+class PlanCache:
+    """LRU map: plan fingerprint → ``CompiledPlan`` (thread-safe)."""
+
+    def __init__(self, maxsize: int = 128):
+        self.maxsize = int(maxsize)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, CompiledPlan]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, plan: PlanNode) -> CompiledPlan:
+        key = plan.fingerprint()
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                tracing.count("engine.plan_cache.hit")
+                return hit
+        # optimize outside the lock (reads file footers for schemas)
+        compiled = CompiledPlan(key, plan, optimize(plan))
+        with self._lock:
+            racer = self._entries.get(key)
+            if racer is not None:  # lost a concurrent-miss race: their entry
+                self._entries.move_to_end(key)
+                self.hits += 1
+                tracing.count("engine.plan_cache.hit")
+                return racer
+            self.misses += 1
+            tracing.count("engine.plan_cache.miss")
+            self._entries[key] = compiled
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+            return compiled
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "size": len(self._entries), "maxsize": self.maxsize}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

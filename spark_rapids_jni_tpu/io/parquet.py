@@ -925,6 +925,13 @@ class ParquetFile:
         return Table([h.to_column() for h in cols],
                      [h.schema.name for h in cols])
 
+    def empty_table(self, columns=None) -> Table:
+        """Zero-row Table with this file's schema (engine empty-scan result)."""
+        empty = [_empty_host(self.schema[i])
+                 for i in self._column_indices(columns)]
+        return Table([h.to_column() for h in empty],
+                     [h.schema.name for h in empty])
+
     def read(self, columns=None, staged: bool | None = None) -> Table:
         """Read into a device Table.
 
@@ -1075,6 +1082,10 @@ class ParquetChunkedReader:
         self.columns = columns
         self.predicate = predicate
         self.prefetch = int(prefetch)
+        # pruning observability: the engine's executor reports these through
+        # its execution stats to prove predicate pushdown engaged
+        self.groups_pruned = 0
+        self.groups_read = 0
         if self.limit <= 0:
             raise ValueError("pass_read_limit must be positive")
 
@@ -1106,7 +1117,9 @@ class ParquetChunkedReader:
     def _chunks_raw(self):
         for gi in range(self.file.num_row_groups):
             if self._group_pruned(gi):
+                self.groups_pruned += 1
                 continue
+            self.groups_read += 1
             hosts = self.file._decode_group(gi, self.columns)
             nrows = hosts[0].num_rows
             if nrows == 0:

@@ -126,8 +126,13 @@ def warm_plan_async(specs) -> None:
 
     def work():
         try:
-            _unpack.lower(jax.ShapeDtypeStruct((total,), jnp.uint32),
-                          plan).compile()
+            # Invoke the live jitted callable on a dummy buffer: this is what
+            # populates jax.jit's DISPATCH cache for (shape, plan).  The
+            # previous .lower().compile() built a throwaway AOT executable —
+            # the next stage_fixed_table still paid the full trace+compile,
+            # defeating the warm.
+            out = _unpack(jnp.zeros((total,), jnp.uint32), plan)
+            jax.block_until_ready(out)
             with _plans_lock:
                 _ready_plans.add(key)
         except Exception as e:  # noqa: BLE001 — backend may reject the plan

@@ -88,6 +88,13 @@ def regex_matches(col: Column, pattern: str,
             raise ValueError(
                 f"pattern {pattern!r} is outside the rewritable subset "
                 "(literal prefix/suffix/contains/equals)")
+        # the host loop is O(rows) Python + a device round-trip per call —
+        # a silent 1000x cliff; name the pattern so it's diagnosable
+        from ..utils.config import logger
+        logger().warning(
+            "regex_matches pattern %r is outside the rewritable subset; "
+            "falling back to the per-row host loop over %d rows",
+            pattern, col.size)
         return _regex_matches_host(col, pattern)
     kind, lit = rw
     if kind == "startswith":

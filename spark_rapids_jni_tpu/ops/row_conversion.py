@@ -756,8 +756,11 @@ def convert_to_rows(table: Table, max_batch_bytes: int = MAX_BATCH_BYTES) -> lis
 
     Analog of ``RowConversion.convertToRows`` (RowConversion.java:101-108).
     Returns multiple columns when the packed output would exceed
-    ``max_batch_bytes`` (reference row_conversion.cu:476-511); batch row counts
-    are a multiple of 32 except possibly the last.
+    ``max_batch_bytes`` (reference row_conversion.cu:476-511).  On the
+    fixed-width path, batch row counts are a multiple of 32 except possibly
+    the last; on the variable-width (STRING) path the 32-row alignment is
+    best-effort only — the byte-greedy batch splitter cuts wherever the
+    byte budget lands, so callers must not rely on it.
 
     STRING columns produce variable-width rows under the UnsafeRow-style
     contract documented above ``VarRowLayout`` (the reference snapshot

@@ -11,12 +11,16 @@ per-op spans.  The TPU equivalents:
   enabled by ``SRJT_TRACE=1`` (visible in Perfetto via ``profile()``).
 - ``profile(logdir)`` — capture a full device trace
   (``jax.profiler.trace``), the Nsight-session analog.
+- ``count(name)`` / ``counters_snapshot()`` — lightweight named event
+  counters (the metrics-registry analog); the engine plan cache reports
+  hits/misses through these.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 
 import jax
 
@@ -53,3 +57,40 @@ def profile(logdir: str):
             run_query(...)
     """
     return jax.profiler.trace(logdir)
+
+
+# -- named event counters --------------------------------------------------
+#
+# Process-wide monotonic counters keyed by dotted name (e.g.
+# "engine.plan_cache.hit").  Cheap enough to leave on unconditionally;
+# thread-safe because the bridge server increments from its serve thread
+# while tests read snapshots from the main thread.
+
+_counters: dict[str, int] = {}
+_counters_lock = threading.Lock()
+
+
+def count(name: str, n: int = 1) -> int:
+    """Increment counter ``name`` by ``n``; returns the new value."""
+    with _counters_lock:
+        v = _counters.get(name, 0) + n
+        _counters[name] = v
+        return v
+
+
+def counter_value(name: str) -> int:
+    with _counters_lock:
+        return _counters.get(name, 0)
+
+
+def counters_snapshot(prefix: str = "") -> dict:
+    """Copy of all counters whose name starts with ``prefix``."""
+    with _counters_lock:
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = "") -> None:
+    """Zero counters under ``prefix`` (tests isolate themselves with this)."""
+    with _counters_lock:
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]

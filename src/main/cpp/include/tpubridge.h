@@ -165,6 +165,13 @@ int tpub_filter(tpub_ctx *ctx, uint64_t table, uint64_t mask_column,
 int tpub_concat(tpub_ctx *ctx, const uint64_t *tables, int32_t ntables,
                 uint64_t *out);
 
+/* Submit a whole serialized query plan (engine/plan.py canonical JSON,
+ * UTF-8) in ONE round-trip; the server optimizes through its plan cache and
+ * executes.  *out_handles receives a malloc'd array of *count result table
+ * handles (free with tpub_free_handles). */
+int tpub_execute_plan(tpub_ctx *ctx, const char *plan_json,
+                      uint64_t **out_handles, int32_t *count);
+
 /* lifecycle --------------------------------------------------------------- */
 int tpub_release(tpub_ctx *ctx, uint64_t handle);
 int tpub_live_count(tpub_ctx *ctx, int32_t *out);

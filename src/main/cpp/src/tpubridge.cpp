@@ -52,6 +52,7 @@ enum Op : uint8_t {
   OP_SORT = 20,
   OP_FILTER = 21,
   OP_CONCAT = 22,
+  OP_PLAN_EXECUTE = 23,
 };
 
 constexpr uint8_t STATUS_OK = 0;
@@ -684,6 +685,28 @@ int tpub_concat(tpub_ctx *ctx, const uint64_t *tables, int32_t ntables,
   put<uint32_t>(payload, (uint32_t)ntables);
   for (int32_t i = 0; i < ntables; ++i) put<uint64_t>(payload, tables[i]);
   return call_handle_out(ctx, OP_CONCAT, payload, out);
+}
+
+int tpub_execute_plan(tpub_ctx *ctx, const char *plan_json,
+                      uint64_t **out_handles, int32_t *count) {
+  if (!plan_json) return ctx->fail("execute_plan: null plan");
+  std::vector<uint8_t> payload, resp;
+  uint32_t plen = (uint32_t)std::strlen(plan_json);
+  put<uint32_t>(payload, plen);
+  payload.insert(payload.end(), (const uint8_t *)plan_json,
+                 (const uint8_t *)plan_json + plen);
+  if (ctx->call(OP_PLAN_EXECUTE, payload, resp) != 0) return -1;
+  if (resp.size() < 4) return ctx->fail("bad plan_execute response");
+  uint32_t n = get<uint32_t>(resp.data());
+  if (resp.size() != 4 + (size_t)n * 8)
+    return ctx->fail("bad plan_execute response");
+  auto *arr = (uint64_t *)std::malloc(n ? n * sizeof(uint64_t) : 1);
+  if (!arr) return ctx->fail("oom");
+  for (uint32_t i = 0; i < n; ++i)
+    arr[i] = get<uint64_t>(resp.data() + 4 + (size_t)i * 8);
+  *out_handles = arr;
+  *count = (int32_t)n;
+  return 0;
 }
 
 int tpub_release(tpub_ctx *ctx, uint64_t handle) {

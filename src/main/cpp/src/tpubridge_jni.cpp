@@ -48,6 +48,7 @@ Java_com_nvidia_spark_rapids_jni_TpuBridge_connectNative(JNIEnv *env, jclass,
   std::lock_guard<std::mutex> lock(g_mu);
   if (g_ctx) return JNI_TRUE;
   const char *path = env->GetStringUTFChars(jpath, nullptr);
+  if (!path) return JNI_FALSE; /* OutOfMemoryError already pending */
   tpub_ctx *raw = tpub_connect(path);
   env->ReleaseStringUTFChars(jpath, path);
   if (!raw) {
@@ -349,31 +350,45 @@ Java_com_nvidia_spark_rapids_jni_TableOps_joinNative(
   return (jlong)out;
 }
 
+/* Path/column names arrive as byte[] of real UTF-8 (String.getBytes(UTF_8)
+ * on the Java side): GetStringUTFChars yields modified UTF-8, whose encoding
+ * of U+0000 and supplementary characters is NOT valid UTF-8, and the server
+ * decodes the wire payload strictly. */
 JNIEXPORT jlong JNICALL
 Java_com_nvidia_spark_rapids_jni_TableOps_readParquetNative(
-    JNIEnv *env, jclass, jstring jpath, jobjectArray jcols) {
+    JNIEnv *env, jclass, jbyteArray jpath, jobjectArray jcols) {
   auto ctx = ctx_or_throw(env);
   if (!ctx) return 0;
-  const char *path = env->GetStringUTFChars(jpath, nullptr);
+  if (!jpath) {
+    throw_runtime(env, "null parquet path");
+    return 0;
+  }
+  jsize plen = env->GetArrayLength(jpath);
+  std::string path((size_t)plen, '\0');
+  if (plen) env->GetByteArrayRegion(jpath, 0, plen, (jbyte *)&path[0]);
   std::vector<std::string> names;
   std::vector<const char *> ptrs;
   if (jcols) {
     jsize n = env->GetArrayLength(jcols);
     names.reserve((size_t)n);
     for (jsize i = 0; i < n; i++) {
-      auto js = (jstring)env->GetObjectArrayElement(jcols, i);
-      const char *s = env->GetStringUTFChars(js, nullptr);
-      names.emplace_back(s);
-      env->ReleaseStringUTFChars(js, s);
-      env->DeleteLocalRef(js);
+      auto jb = (jbyteArray)env->GetObjectArrayElement(jcols, i);
+      if (!jb) {
+        throw_runtime(env, "null column name");
+        return 0;
+      }
+      jsize len = env->GetArrayLength(jb);
+      std::string s((size_t)len, '\0');
+      if (len) env->GetByteArrayRegion(jb, 0, len, (jbyte *)&s[0]);
+      names.push_back(std::move(s));
+      env->DeleteLocalRef(jb);
     }
     for (const auto &s : names) ptrs.push_back(s.c_str());
   }
   uint64_t out = 0;
-  int rc = tpub_read_parquet(ctx.get(), path,
+  int rc = tpub_read_parquet(ctx.get(), path.c_str(),
                              ptrs.empty() ? nullptr : ptrs.data(),
                              (int32_t)ptrs.size(), &out);
-  env->ReleaseStringUTFChars(jpath, path);
   if (rc != 0) {
     throw_runtime(env, tpub_last_error(ctx.get()));
     return 0;

@@ -8,6 +8,8 @@
  */
 package com.nvidia.spark.rapids.jni;
 
+import java.nio.charset.StandardCharsets;
+
 public final class TableOps {
   private TableOps() {}
 
@@ -66,9 +68,22 @@ public final class TableOps {
                                       leftKeys, rightKeys, how));
   }
 
-  /** Scan a parquet file (path visible to the device server). */
+  /**
+   * Scan a parquet file (path visible to the device server).  Names cross
+   * JNI as {@code byte[]} of real UTF-8: {@code GetStringUTFChars} would
+   * hand the native side modified UTF-8, which the server's strict UTF-8
+   * decode rejects for U+0000 / supplementary characters.
+   */
   public static DeviceTable readParquet(String path, String[] columns) {
-    return new DeviceTable(readParquetNative(path, columns));
+    byte[] pathUtf8 = path.getBytes(StandardCharsets.UTF_8);
+    byte[][] colsUtf8 = null;
+    if (columns != null) {
+      colsUtf8 = new byte[columns.length][];
+      for (int i = 0; i < columns.length; i++) {
+        colsUtf8[i] = columns[i].getBytes(StandardCharsets.UTF_8);
+      }
+    }
+    return new DeviceTable(readParquetNative(pathUtf8, colsUtf8));
   }
 
   public static DeviceTable readParquet(String path) {
@@ -113,7 +128,8 @@ public final class TableOps {
   private static native long joinNative(long leftHandle, long rightHandle,
                                         int[] leftKeys, int[] rightKeys,
                                         int how);
-  private static native long readParquetNative(String path, String[] columns);
+  private static native long readParquetNative(byte[] pathUtf8,
+                                               byte[][] columnsUtf8);
   private static native long sortNative(long tableHandle, int[] keys,
                                         int[] ascending, int[] nullsFirst);
   private static native long filterNative(long tableHandle, long maskHandle);

@@ -1,0 +1,210 @@
+"""Plan DAG + optimizer unit tests: stable serialized form and each rewrite.
+
+The engine's contract (docs/ENGINE.md): a plan is a frozen-dataclass DAG
+whose canonical JSON form round-trips losslessly and fingerprints stably
+(the plan-cache key), and the optimizer's three rules — filter-below-join
+reordering, predicate absorption into Scan row-group pruning, projection
+pruning — each rewrite the tree without changing its semantics.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.engine import (
+    Aggregate, Filter, Join, Limit, Project, Scan, Sort,
+    col, deserialize, expr_columns, from_dict, lit, optimize,
+)
+from spark_rapids_jni_tpu.engine.plan import rebuild, topo_nodes
+
+
+# -- construction & validation ---------------------------------------------
+
+def test_node_validation_errors():
+    s = Scan("t.parquet")
+    with pytest.raises(ValueError, match="unknown scan format"):
+        Scan("t.csv", format="csv")
+    with pytest.raises(ValueError, match="column, lo, hi"):
+        Scan("t.parquet", predicate=("a", 1))
+    with pytest.raises(ValueError, match="unknown expression op"):
+        Filter(s, ("like", col("a"), lit("x")))
+    with pytest.raises(ValueError, match="two operands"):
+        Filter(s, (">=", col("a")))
+    with pytest.raises(ValueError, match="unknown join how"):
+        Join(s, s, ["a"], ["a"], how="outer")
+    with pytest.raises(ValueError, match="key count mismatch"):
+        Join(s, s, ["a", "b"], ["a"])
+    with pytest.raises(ValueError, match="unknown aggregate op"):
+        Aggregate(s, ["k"], [("v", "median")])
+    with pytest.raises(ValueError, match="requires a column"):
+        Aggregate(s, ["k"], [(None, "sum")])
+    with pytest.raises(ValueError, match="length mismatch"):
+        Aggregate(s, ["k"], [("v", "sum")], names=["a", "b"])
+    with pytest.raises(ValueError, match=">= 0"):
+        Limit(s, -1)
+
+
+def test_expr_columns_and_default_agg_names():
+    e = ("&", (">=", col("a"), lit(1)), ("not", ("==", col("b"), col("c"))))
+    assert expr_columns(e) == {"a", "b", "c"}
+    agg = Aggregate(Scan("t.parquet"), ["k"],
+                    [("v", "sum"), (None, "count_all")])
+    assert agg.names == ("sum_v", "count")
+
+
+def _sample_plan():
+    fact = Scan("sales.parquet", chunk_bytes=1 << 20)
+    dim = Filter(Scan("dim.parquet"),
+                 (">=", col("d_key"), lit(10)))
+    j = Join(fact, dim, ["f_key"], ["d_key"], how="semi")
+    agg = Aggregate(j, ["f_store"], [("f_price", "sum")], names=["sales"])
+    return Sort(Limit(agg, 100), (("sales", False),))
+
+
+# -- serialization ---------------------------------------------------------
+
+def test_serialize_roundtrip_and_fingerprint():
+    p = _sample_plan()
+    blob = p.serialize()
+    q = deserialize(blob)
+    # structurally identical: same canonical bytes, same fingerprint
+    assert q.serialize() == blob
+    assert q.fingerprint() == p.fingerprint()
+    # fingerprint is content-addressed: independent builds agree ...
+    assert _sample_plan().fingerprint() == p.fingerprint()
+    # ... and any structural change shows
+    other = Sort(Limit(_sample_plan().child.child, 101), (("sales", False),))
+    assert other.fingerprint() != p.fingerprint()
+
+
+def test_shared_node_serializes_once():
+    shared = Scan("t.parquet")
+    j = Join(Filter(shared, (">", col("a"), lit(0))), shared,
+             ["a"], ["a"], how="inner")
+    d = j.to_dict()
+    assert sum(1 for n in d["nodes"] if n["op"] == "Scan") == 1
+    back = from_dict(d)
+    scans = [n for n in topo_nodes(back) if isinstance(n, Scan)]
+    assert len(scans) == 1  # sharing survives the round-trip
+
+
+def test_from_dict_rejects_bad_input():
+    with pytest.raises(ValueError, match="unsupported plan version"):
+        from_dict({"version": 99, "root": 0, "nodes": []})
+    with pytest.raises(ValueError, match="unknown plan node op"):
+        from_dict({"version": 1, "root": 0,
+                   "nodes": [{"op": "Window", "child": 0}]})
+
+
+def test_rebuild_preserves_identity_when_noop():
+    s = Scan("t.parquet")
+    assert rebuild(s) is s
+    assert rebuild(s, columns=("a",)).columns == ("a",)
+
+
+# -- optimizer rules -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    """Two tiny parquet files so the optimizer can resolve scan schemas."""
+    root = tmp_path_factory.mktemp("opt")
+    pq.write_table(pa.table({
+        "f_key": pa.array(np.arange(100, dtype=np.int64)),
+        "f_store": pa.array(np.arange(100, dtype=np.int64) % 7),
+        "f_price": pa.array(np.arange(100, dtype=np.float64)),
+        "f_unused": pa.array(np.zeros(100, np.int64)),
+    }), root / "fact.parquet")
+    pq.write_table(pa.table({
+        "d_key": pa.array(np.arange(100, dtype=np.int64)),
+        "d_name": pa.array([f"n{i}" for i in range(100)]),
+        "d_unused": pa.array(np.zeros(100, np.int64)),
+    }), root / "dim.parquet")
+    return root
+
+
+def test_projection_pruning_sets_scan_columns(files):
+    plan = Aggregate(
+        Join(Scan(files / "fact.parquet"), Scan(files / "dim.parquet"),
+             ["f_key"], ["d_key"], how="inner"),
+        ["d_name"], [("f_price", "sum")], names=["sales"])
+    opt = optimize(plan)
+    scans = {n.path.split("/")[-1]: n for n in topo_nodes(opt)
+             if isinstance(n, Scan)}
+    # only the columns the query touches survive, in file-schema order
+    assert scans["fact.parquet"].columns == ("f_key", "f_price")
+    assert scans["dim.parquet"].columns == ("d_key", "d_name")
+
+
+def test_predicate_chain_absorbed_into_scan(files):
+    # a Filter-over-Filter chain: BOTH bounds must land in one predicate
+    inner = Filter(Scan(files / "fact.parquet"),
+                   (">=", col("f_key"), lit(20)))
+    plan = Filter(inner, ("<=", col("f_key"), lit(60)))
+    opt = optimize(plan)
+    scan = [n for n in topo_nodes(opt) if isinstance(n, Scan)][0]
+    assert scan.predicate == ("f_key", 20, 60)
+    # the row filters stay (footer-stats pruning is conservative)
+    assert isinstance(opt, Filter)
+
+
+def test_strict_bounds_tighten_for_ints(files):
+    plan = Filter(Scan(files / "fact.parquet"),
+                  ("&", (">", col("f_key"), lit(5)),
+                   ("<", col("f_key"), lit(9))))
+    opt = optimize(plan)
+    scan = [n for n in topo_nodes(opt) if isinstance(n, Scan)][0]
+    assert scan.predicate == ("f_key", 6, 8)
+
+
+def test_filter_pushed_below_join(files):
+    # a left-side-only predicate sitting ABOVE a semi join must sink onto
+    # the fact side (where it can then feed the scan's row-group pruning)
+    j = Join(Scan(files / "fact.parquet"), Scan(files / "dim.parquet"),
+             ["f_key"], ["d_key"], how="semi")
+    plan = Filter(j, (">=", col("f_store"), lit(3)))
+    opt = optimize(plan)
+    assert isinstance(opt, Join)  # filter no longer on top
+    assert isinstance(opt.left, Filter)
+    assert opt.left.predicate == (">=", col("f_store"), lit(3))
+
+
+def test_right_side_push_renames_suffixed_columns(files):
+    # inner-join output suffixes colliding right names with _r; a predicate
+    # over a right-only (unsuffixed) column must push with its own name
+    j = Join(Scan(files / "fact.parquet"), Scan(files / "dim.parquet"),
+             ["f_key"], ["d_key"], how="inner")
+    plan = Filter(j, ("==", col("d_name"), lit("n7")))
+    opt = optimize(plan)
+    assert isinstance(opt, Join)
+    assert isinstance(opt.right, Filter)
+    assert opt.right.predicate == ("==", col("d_name"), lit("n7"))
+
+
+def test_conjunction_splits_across_sides(files):
+    j = Join(Scan(files / "fact.parquet"), Scan(files / "dim.parquet"),
+             ["f_key"], ["d_key"], how="inner")
+    both = ("&", (">=", col("f_store"), lit(1)),
+            ("==", col("d_name"), lit("n3")))
+    opt = optimize(Filter(j, both))
+    assert isinstance(opt, Join)
+    assert isinstance(opt.left, Filter) and isinstance(opt.right, Filter)
+
+
+def test_mixed_side_predicate_stays_above_join(files):
+    j = Join(Scan(files / "fact.parquet"), Scan(files / "dim.parquet"),
+             ["f_key"], ["d_key"], how="inner")
+    mixed = ("==", col("f_store"), col("d_unused"))
+    opt = optimize(Filter(j, mixed))
+    assert isinstance(opt, Filter)  # references both sides: cannot sink
+    assert opt.predicate == mixed
+
+
+def test_optimize_is_pure(files):
+    """optimize() returns a rewritten tree; the input plan is untouched."""
+    scan = Scan(files / "fact.parquet")
+    plan = Filter(scan, (">=", col("f_key"), lit(10)))
+    fp = plan.fingerprint()
+    optimize(plan)
+    assert plan.fingerprint() == fp
+    assert scan.predicate is None and scan.columns is None

@@ -529,3 +529,49 @@ def test_staging_plan_for_matches_packed_plan():
         "_plan_for's key does not match the plan stage_fixed_table packed"
     np.testing.assert_array_equal(np.asarray(out.column("a").data),
                                   specs[0][2])
+
+
+def test_warm_plan_really_warms_dispatch_cache(tmp_path, monkeypatch):
+    """The first scan of a fresh (schema, row-bucket) ships per-column and
+    warms the staged unpack on a background thread; the SECOND scan must
+    take the staged path without recompiling — warm_plan_async has to
+    populate jax.jit's dispatch cache (invoking the jitted callable), not
+    just build a throwaway AOT executable."""
+    import time
+    from spark_rapids_jni_tpu.io import staging, write_parquet
+    from spark_rapids_jni_tpu.columnar import Column, Table
+
+    # a dtype mix no other test stages, so the plan is cold here
+    n = 3_000
+    rng = np.random.default_rng(33)
+    t = Table([
+        Column.from_numpy(rng.integers(-9, 9, n).astype(np.int64)),
+        Column.from_numpy(rng.integers(-9, 9, n).astype(np.int16),
+                          validity=rng.random(n) > 0.2),
+        Column.from_numpy(rng.random(n).astype(np.float32)),
+        Column.from_numpy(rng.integers(-9, 9, n).astype(np.int64),
+                          validity=rng.random(n) > 0.4),
+    ], ["w_a", "w_b", "w_c", "w_d"])
+    p = tmp_path / "warm.parquet"
+    write_parquet(t, p)
+
+    ready_before = len(staging._ready_plans)
+    first = read_parquet(p)           # per-column now, warm in background
+    deadline = time.monotonic() + 60
+    while len(staging._ready_plans) <= ready_before:
+        assert time.monotonic() < deadline, "background warm never landed"
+        assert not staging._failed_plans, staging._failed_plans
+        time.sleep(0.02)
+
+    compiled = staging._unpack._cache_size()
+    staged_calls = []
+    real = staging.stage_fixed_table
+    monkeypatch.setattr(staging, "stage_fixed_table",
+                        lambda specs: staged_calls.append(1) or real(specs))
+    second = read_parquet(p)          # must take the staged path...
+    assert staged_calls, "second scan did not take the staged path"
+    assert staging._unpack._cache_size() == compiled, \
+        "staged path recompiled: the warm was a no-op"
+    for nm in t.names:
+        assert second[nm].to_pylist() == first[nm].to_pylist() \
+            == t[nm].to_pylist(), nm
