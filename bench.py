@@ -627,6 +627,208 @@ def bench_engine_q5(n=200_000):
             "cache_hits": cache["hits"], "cache_misses": cache["misses"]}
 
 
+def _pipeline_warehouse(root, n, rng):
+    """q5-lite warehouse for the local-executor pipeline bench."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pq.write_table(pa.table({
+        "ss_sold_date_sk": pa.array(
+            np.sort(rng.integers(0, 400, n)).astype(np.int64)),
+        "ss_store_sk": pa.array(rng.integers(1, 13, n).astype(np.int64)),
+        "ss_ext_sales_price": pa.array(rng.uniform(0.5, 300.0, n)),
+        "ss_net_profit": pa.array(rng.uniform(-50.0, 120.0, n)),
+    }), os.path.join(root, "store_sales.parquet"),
+        row_group_size=max(1, n // 8))
+    pq.write_table(pa.table({
+        "d_date_sk": pa.array(np.arange(100, 300, dtype=np.int64)),
+    }), os.path.join(root, "date_dim.parquet"))
+    pq.write_table(pa.table({
+        "s_store_sk": pa.array(np.arange(1, 13, dtype=np.int64)),
+        "s_mgr": pa.array(np.arange(1, 13, dtype=np.int64) % 4),
+    }), os.path.join(root, "store.parquet"))
+
+
+def _pipeline_plans(root, chunk_bytes):
+    """(q5-lite plan, chunked-scan aggregate plan) over the warehouse.
+
+    The q5 filters survive optimization as real Filter nodes (the scan
+    predicate only prunes row groups), so the fused executor has chains to
+    compile; the chunked aggregate feeds the scan straight into a fused
+    partial-groupby segment — the double-buffered streaming shape.
+    """
+    from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Join, Scan,
+                                             Sort, col, lit)
+    dates_f = Filter(Scan(os.path.join(root, "date_dim.parquet")),
+                     ("&", (">=", col("d_date_sk"), lit(100)),
+                      ("<", col("d_date_sk"), lit(300))))
+    sales = Scan(os.path.join(root, "store_sales.parquet"))
+    kept = Filter(Join(sales, dates_f, ["ss_sold_date_sk"], ["d_date_sk"],
+                       how="semi"),
+                  ("&", (">", col("ss_net_profit"), lit(0.0)),
+                   (">=", col("ss_sold_date_sk"), lit(100))))
+    totals = Aggregate(kept, ["ss_store_sk"],
+                       [("ss_ext_sales_price", "sum"),
+                        ("ss_net_profit", "sum"),
+                        ("ss_ext_sales_price", "count")],
+                       names=["sales", "profit", "n"])
+    joined = Join(totals, Scan(os.path.join(root, "store.parquet")),
+                  ["ss_store_sk"], ["s_store_sk"], how="inner")
+    q5 = Sort(Aggregate(joined, ["s_mgr"],
+                        [("sales", "sum"), ("profit", "sum"), ("n", "sum")],
+                        names=["sales", "profit", "n"]),
+              (("s_mgr", True),))
+
+    chunked = Aggregate(
+        Filter(Scan(os.path.join(root, "store_sales.parquet"),
+                    chunk_bytes=chunk_bytes),
+               (">", col("ss_ext_sales_price"), lit(1.0))),
+        ["ss_store_sk"],
+        [("ss_ext_sales_price", "sum"), ("ss_net_profit", "sum"),
+         ("ss_net_profit", "min"), ("ss_net_profit", "max"),
+         ("ss_ext_sales_price", "count")],
+        names=["sales", "profit", "lo", "hi", "n"])
+    return q5, chunked
+
+
+def _run_plan(opt, fused, prefetch):
+    """One timed local execute; blocks until the result is ready."""
+    import jax
+    from spark_rapids_jni_tpu.engine import execute, new_stats
+    stats = new_stats()
+    t0 = time.perf_counter()
+    out = execute(opt, stats, fused=fused, prefetch=prefetch)
+    jax.block_until_ready([c.data for c in out.columns
+                           if c.data is not None])
+    return time.perf_counter() - t0, out, stats
+
+
+def _tables_match(a, b) -> bool:
+    if a.num_rows != b.num_rows or a.num_columns != b.num_columns:
+        return False
+    for ca, cb in zip(a.columns, b.columns):
+        if not np.allclose(np.asarray(ca.data, np.float64),
+                           np.asarray(cb.data, np.float64)):
+            return False
+    return True
+
+
+def bench_engine_pipeline(n=600_000, chunk_bytes=512_000, smoke=False):
+    """Fused-segment compilation + double-buffered streaming vs PR 1.
+
+    Two comparisons on the LOCAL executor (no bridge — this measures the
+    execution engine itself):
+
+    - q5-lite, warm: node-by-node interpreter (``fused=False``, the PR 1
+      executor) vs fused segments (Filter/Project/Aggregate chains as one
+      jitted program each).  Cold fused time is reported too: it pays the
+      segment trace+compile the ``engine.segment_cache`` then amortizes.
+    - chunked-scan aggregate: serial chunk streaming (``prefetch=0``) vs
+      double-buffered (``prefetch=2``) on the same fused plan, plus the
+      interpreted loop both ways — overlap hides host decode behind device
+      compute; the interpreted loop ALSO syncs per chunk, so it shows the
+      overlap even when device compute is cheap.
+
+    ``smoke=True``: tiny shapes, correctness cross-checks only, no timing
+    claims — the CI hook that keeps the perf paths importable+runnable.
+    """
+    import tempfile
+
+    from spark_rapids_jni_tpu.engine import optimize
+    from spark_rapids_jni_tpu.engine.segment import SEGMENT_CACHE
+    from spark_rapids_jni_tpu.ops.selection import sort_table
+    from spark_rapids_jni_tpu.ops.order import SortKey
+
+    rng = np.random.default_rng(13)
+    with tempfile.TemporaryDirectory() as tmp:
+        root = os.path.join(tmp, "wh")
+        os.mkdir(root)
+        _pipeline_warehouse(root, n, rng)
+        q5, chunked = _pipeline_plans(root, chunk_bytes)
+        q5_opt, ch_opt = optimize(q5), optimize(chunked)
+
+        def sorted_by_key(t):
+            return sort_table(t, [SortKey(t[t.names[0]], ascending=True)])
+
+        # q5-lite: cold fused (segment trace+compile), then warm both ways
+        t_cold, out_f, _ = _run_plan(q5_opt, fused=True, prefetch=0)
+        t_fused = min(_run_plan(q5_opt, fused=True, prefetch=0)[0]
+                      for _ in range(1 if smoke else 3))
+        _run_plan(q5_opt, fused=False, prefetch=0)  # warm interp caches too
+        t_interp, out_i, _ = _run_plan(q5_opt, fused=False, prefetch=0)
+        if not smoke:
+            t_interp = min(t_interp, *(
+                _run_plan(q5_opt, fused=False, prefetch=0)[0]
+                for _ in range(2)))
+        q5_match = _tables_match(out_f, out_i)
+
+        # chunked streaming aggregate: serial vs double-buffered.
+        # A/B pairs interleaved and min-taken — on a saturated host the
+        # run-to-run noise is the same order as the overlap win, and
+        # alternating keeps cache/thermal drift out of the ratio.
+        reps = 1 if smoke else 3
+        _run_plan(ch_opt, fused=True, prefetch=0)   # compile warm-up
+        _run_plan(ch_opt, fused=False, prefetch=0)  # warm interp loop
+        t_serial = t_overlap = t_iserial = t_ioverlap = float("inf")
+        out_s = st_s = out_o = st_o = out_is = out_io = None
+        for _ in range(reps):
+            dt, out_s, st_s = _run_plan(ch_opt, fused=True, prefetch=0)
+            t_serial = min(t_serial, dt)
+            dt, out_o, st_o = _run_plan(ch_opt, fused=True, prefetch=2)
+            t_overlap = min(t_overlap, dt)
+            dt, out_is, _ = _run_plan(ch_opt, fused=False, prefetch=0)
+            t_iserial = min(t_iserial, dt)
+            dt, out_io, _ = _run_plan(ch_opt, fused=False, prefetch=2)
+            t_ioverlap = min(t_ioverlap, dt)
+        stream_match = (_tables_match(sorted_by_key(out_s),
+                                      sorted_by_key(out_o))
+                        and _tables_match(sorted_by_key(out_s),
+                                          sorted_by_key(out_is))
+                        and _tables_match(sorted_by_key(out_is),
+                                          sorted_by_key(out_io)))
+
+    seg = SEGMENT_CACHE.stats()
+    return {
+        "q5_cold_fused_ms": t_cold * 1e3,
+        "q5_warm_fused_ms": t_fused * 1e3,
+        "q5_warm_interp_ms": t_interp * 1e3,
+        "fused_vs_interp": t_interp / t_fused if t_fused else None,
+        # headline overlap ratio: the per-chunk-sync streaming loop (PR 1's
+        # serial streaming aggregate) — the consumer blocks on every chunk's
+        # groupby sync, which is exactly the idle time double-buffered decode
+        # hides.  The fused loop's consumer never blocks (async dispatch, one
+        # sync at the combine), so on a single-core CPU host its A/B is a
+        # wash — reported separately; on a tunneled TPU the fused consumer
+        # DOES block on transfers, which is the deploy case for prefetch.
+        "stream_serial_ms": t_iserial * 1e3,
+        "stream_overlap_ms": t_ioverlap * 1e3,
+        "overlap_vs_serial": t_iserial / t_ioverlap if t_ioverlap else None,
+        "fused_stream_serial_ms": t_serial * 1e3,
+        "fused_stream_overlap_ms": t_overlap * 1e3,
+        "fused_overlap_vs_serial": (t_serial / t_overlap
+                                    if t_overlap else None),
+        "chunks": st_s["chunks"],
+        "fused_streamed": bool(st_o["fused_segments"]),
+        "results_match": bool(q5_match and stream_match),
+        "segment_cache": {"hits": seg["hits"], "misses": seg["misses"],
+                          "evictions": seg["evictions"]},
+    }
+
+
+def smoke():
+    """``bench.py --smoke``: tiny shapes through the fused + pipelined
+    paths end-to-end, correctness-only (no timing assertions) — wired into
+    ci/premerge.sh so perf-path exceptions fail fast in tier-1 budget."""
+    import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
+    res = bench_engine_pipeline(n=20_000, chunk_bytes=48_000, smoke=True)
+    ok = bool(res and res["results_match"] and res["fused_streamed"]
+              and res["chunks"] > 1)
+    print(json.dumps({"metric": "engine_pipeline_smoke",
+                      "ok": ok,
+                      "chunks": res["chunks"] if res else None,
+                      "segment_cache": res["segment_cache"] if res else None}))
+    return 0 if ok else 1
+
+
 def main():
     import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
 
@@ -639,6 +841,7 @@ def main():
     win_dev, win_cpu = bench_window()
     smj = bench_distributed_join()
     eng = bench_engine_q5()
+    pipe = bench_engine_pipeline()
 
     # vs_baseline is measured/PINNED (BENCH_BASELINES.json), so the ratio is
     # comparable across rounds; the live re-measure of each baseline is
@@ -735,9 +938,42 @@ def main():
                         "no pinned baseline yet (first round with the "
                         "engine in the tree)"}}
                if eng else {}),
+            **({"engine_pipeline": {
+                "q5_cold_fused_ms": round(pipe["q5_cold_fused_ms"], 1),
+                "q5_warm_fused_ms": round(pipe["q5_warm_fused_ms"], 1),
+                "q5_warm_interp_ms": round(pipe["q5_warm_interp_ms"], 1),
+                "fused_vs_interp": round(pipe["fused_vs_interp"], 3),
+                "stream_serial_ms": round(pipe["stream_serial_ms"], 1),
+                "stream_overlap_ms": round(pipe["stream_overlap_ms"], 1),
+                "overlap_vs_serial": round(pipe["overlap_vs_serial"], 3),
+                "fused_stream_serial_ms": round(
+                    pipe["fused_stream_serial_ms"], 1),
+                "fused_stream_overlap_ms": round(
+                    pipe["fused_stream_overlap_ms"], 1),
+                "fused_overlap_vs_serial": round(
+                    pipe["fused_overlap_vs_serial"], 3),
+                "chunks": pipe["chunks"],
+                "results_match": pipe["results_match"],
+                "segment_cache": pipe["segment_cache"],
+                "note": "LOCAL executor. fused_vs_interp: warm fused "
+                        "segments vs the PR 1 node-by-node interpreter on "
+                        "the q5-lite shape (>1 means fused wins). "
+                        "overlap_vs_serial: double-buffered (prefetch=2) "
+                        "vs serial (prefetch=0) chunk streaming on the "
+                        "chunked-scan aggregate's per-chunk-sync loop, "
+                        "min of interleaved A/B pairs (>1 means overlap "
+                        "wins); fused_* is the same A/B on the fused "
+                        "streaming loop, whose consumer never blocks "
+                        "per chunk — on a 1-core CPU host there is no "
+                        "idle wait for the producer to hide behind, so "
+                        "~1.0 is expected there until a real accelerator "
+                        "link is in the loop"}}
+               if pipe else {}),
         },
     }))
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv[1:]:
+        sys.exit(smoke())
     sys.exit(main())

@@ -20,6 +20,12 @@ cmake --build target/cpp-build
 # full suite on the virtual 8-device CPU mesh (includes bridge round trip)
 python -m pytest tests/ -q
 
+# engine perf-path smoke: tiny shapes through the fused-segment and
+# double-buffered streaming paths end-to-end (correctness cross-checks,
+# no timing assertions) — keeps the bench's perf paths runnable without
+# paying full bench time in the gate
+JAX_PLATFORMS=cpu python bench.py --smoke
+
 # the driver's multi-chip entry must keep compiling + executing
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

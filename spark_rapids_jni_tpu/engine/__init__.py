@@ -3,11 +3,15 @@
 The layer Spark plays for the reference repo, grown natively: build a
 ``Scan/Filter/Project/Join/Aggregate/Sort/Limit`` DAG (plan.py), let
 ``optimize`` prune projections and push predicates into scan row-group
-pruning (optimizer.py), then ``execute`` it on the ops/io layers with
-streaming per-chunk partial aggregation (executor.py) — or go through
-``PlanCache`` (cache.py) so repeat queries skip optimization and hit warm
-jit caches.  ``docs/ENGINE.md`` has the full design, including the bridge's
-one-message ``PLAN_EXECUTE`` wire format.
+pruning (optimizer.py), then ``execute`` it on the ops/io layers
+(executor.py): Filter/Project/Aggregate chains between breakers fuse into
+single jitted segments cached by (fingerprint, shape-class) in
+``SEGMENT_CACHE`` (segment.py), and chunked scans stream double-buffered —
+a producer thread decodes+stages chunk k+1 while chunk k computes, partials
+accumulating on device with no per-chunk sync.  ``PlanCache`` (cache.py)
+lets repeat queries skip optimization and hit the warm jit caches.
+``docs/ENGINE.md`` has the full design, including the bridge's one-message
+``PLAN_EXECUTE`` wire format.
 """
 
 from .plan import (  # noqa: F401
@@ -28,3 +32,10 @@ from .plan import (  # noqa: F401
 from .optimizer import optimize, output_names  # noqa: F401
 from .executor import execute, new_stats  # noqa: F401
 from .cache import CompiledPlan, PlanCache  # noqa: F401
+from .segment import (  # noqa: F401
+    SEGMENT_CACHE,
+    CompiledSegment,
+    Segment,
+    SegmentCache,
+    build_segment,
+)

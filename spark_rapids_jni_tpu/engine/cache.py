@@ -44,14 +44,28 @@ class CompiledPlan:
 
 
 class PlanCache:
-    """LRU map: plan fingerprint → ``CompiledPlan`` (thread-safe)."""
+    """LRU map: plan fingerprint → ``CompiledPlan`` (thread-safe).
 
-    def __init__(self, maxsize: int = 128):
-        self.maxsize = int(maxsize)
+    Capacity defaults to ``SRJT_PLAN_CACHE`` (utils.config, env override);
+    evictions are recorded alongside hits/misses in both ``stats()`` and
+    the tracing counter registry (``engine.plan_cache.eviction``).
+    """
+
+    def __init__(self, maxsize: Optional[int] = None):
+        self._maxsize = None if maxsize is None else int(maxsize)
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, CompiledPlan]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    @property
+    def maxsize(self) -> int:
+        # resolved per use, not at construction, so SRJT_PLAN_CACHE +
+        # config.refresh() retunes live caches (bridge servers included)
+        from ..utils.config import config
+        return self._maxsize if self._maxsize is not None \
+            else config.plan_cache
 
     def get(self, plan: PlanNode) -> CompiledPlan:
         key = plan.fingerprint()
@@ -76,6 +90,8 @@ class PlanCache:
             self._entries[key] = compiled
             while len(self._entries) > self.maxsize:
                 self._entries.popitem(last=False)
+                self.evictions += 1
+                tracing.count("engine.plan_cache.eviction")
             return compiled
 
     def __len__(self) -> int:
@@ -85,6 +101,7 @@ class PlanCache:
     def stats(self) -> dict:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
                     "size": len(self._entries), "maxsize": self.maxsize}
 
     def clear(self) -> None:

@@ -1,0 +1,400 @@
+"""Whole-stage segment fusion: compile plan chains into single XLA programs.
+
+PR 1's executor interprets the optimized DAG node-by-node: every Filter
+materializes a compacted intermediate (eval + nonzero + gather + one host
+sync), every Project dispatches, and the Aggregate on top re-reads it all.
+Flare's result (PAPERS.md, arxiv 1703.08219) is that whole-stage native
+compilation of exactly these chains is the dominant win for Spark-style
+plans.  The TPU translation:
+
+- A **segment** is a maximal Filter/Project chain, optionally rooted by a
+  decomposable Aggregate, between pipeline breakers (Scan, Join, Sort,
+  Limit, exchange).  Breakers materialize; segments must not.
+- Each segment traces ONCE into one ``jax.jit`` callable over the input
+  ``Table`` pytree.  Filters never compact inside the program — they AND
+  into a live-row mask (the static-shape discipline every padded op here
+  already follows), Projects are metadata-only selects, and an Aggregate
+  root feeds the mask straight into ``groupby_padded(row_mask=...)``.
+  Intermediates therefore never materialize: one fused program, one
+  dispatch, at most one host sync at the segment boundary.
+- Compiled segments live in a process-wide LRU keyed by
+  ``(segment fingerprint, input shape-class)`` with hit/miss/eviction
+  counters in ``utils.tracing`` (``engine.segment_cache.*``).  The
+  shape-class is the (row-bucket, schema) signature: chunked scans pad
+  rows to power-of-two buckets (io/staging.py), so every same-schema chunk
+  re-enters the same compiled executable instead of retracing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..utils import tracing
+from ..utils.config import config
+from .plan import Aggregate, Filter, PlanNode, Project, expr_columns, topo_nodes
+
+#: chain members fusable into a segment body (everything else is a breaker)
+_FUSABLE = (Filter, Project)
+
+
+# -- segment extraction ----------------------------------------------------
+
+def parent_counts(root: PlanNode) -> dict:
+    """id(node) -> number of parents in the DAG (shared nodes must
+    materialize once, so they terminate segment growth)."""
+    counts: dict = {}
+    for n in topo_nodes(root):
+        for c in n.children():
+            counts[id(c)] = counts.get(id(c), 0) + 1
+    return counts
+
+
+def _agg_fusable(agg: Aggregate) -> bool:
+    from ..ops.aggregate import _FAST_OPS
+    return bool(agg.keys) and all(op in _FAST_OPS for _, op in agg.aggs)
+
+
+class Segment:
+    """One fusable chain: ``input -> chain (bottom-up) [-> agg]``."""
+
+    __slots__ = ("chain", "agg", "input", "_fp")
+
+    def __init__(self, chain: tuple, agg: Optional[Aggregate],
+                 input_node: PlanNode):
+        self.chain = chain          # Filter/Project nodes, execution order
+        self.agg = agg              # optional Aggregate root
+        self.input = input_node     # breaker output the segment consumes
+        self._fp: Optional[str] = None
+
+    def nodes(self) -> tuple:
+        return self.chain + ((self.agg,) if self.agg is not None else ())
+
+    def fingerprint(self) -> str:
+        """Structure-only identity (the plan-cache analog, input excluded):
+        equal chains over different inputs share compiled executables."""
+        if self._fp is None:
+            sig = []
+            for nd in self.chain:
+                sig.append(("filter", nd.predicate) if isinstance(nd, Filter)
+                           else ("project", tuple(nd.columns)))
+            if self.agg is not None:
+                sig.append(("aggregate", tuple(self.agg.keys),
+                            tuple(self.agg.aggs), tuple(self.agg.names)))
+            self._fp = hashlib.sha256(repr(tuple(sig)).encode()).hexdigest()
+        return self._fp
+
+    def columns_used(self) -> set:
+        cols = set()
+        for nd in self.chain:
+            if isinstance(nd, Filter):
+                cols |= expr_columns(nd.predicate)
+        if self.agg is not None:
+            cols |= set(self.agg.keys)
+            cols |= {c for c, _ in self.agg.aggs if c is not None}
+        return cols
+
+
+def build_segment(top: PlanNode, nparents: dict) -> Optional[Segment]:
+    """The segment rooted at ``top``, or None when ``top`` can't root one.
+
+    ``top`` itself is always included (it was requested); deeper nodes are
+    absorbed only while they are Filter/Project with exactly one parent —
+    a shared subtree must materialize once for its other consumers.
+    """
+    if isinstance(top, Aggregate):
+        if not _agg_fusable(top):
+            return None
+        agg, cur, absorb_first = top, top.child, False
+    elif isinstance(top, _FUSABLE):
+        agg, cur, absorb_first = None, top, True
+    else:
+        return None
+    chain = []
+    while isinstance(cur, _FUSABLE) and \
+            (absorb_first or nparents.get(id(cur), 1) == 1):
+        absorb_first = False
+        chain.append(cur)
+        cur = cur.child
+    return Segment(tuple(reversed(chain)), agg, cur)
+
+
+def worthwhile(seg: Segment, streaming: bool = False) -> bool:
+    """Fusion must beat the interpreter to be worth a compile: a lone
+    Project is a metadata select and a bare Aggregate already runs as one
+    compiled program — except on the streaming path, where a fused agg
+    segment is what lets per-chunk partials stay padded on device (no
+    per-chunk host sync), so any agg root qualifies there."""
+    if seg.agg is not None:
+        return streaming or len(seg.chain) >= 1
+    return len(seg.chain) >= 2 and \
+        any(isinstance(nd, Filter) for nd in seg.chain)
+
+
+def runtime_eligible(seg: Segment, table: Table) -> bool:
+    """Static fusability said yes; the actual input schema gets the veto:
+    computed-on columns must be 1-D fixed-width (strings may pass THROUGH
+    a segment untouched, but can't be filtered on or aggregated)."""
+    if seg.agg is not None and table.num_rows == 0:
+        return False  # empty-input agg: let groupby's host path handle it
+    try:
+        for name in seg.columns_used():
+            c = table.column(name)
+            if c.dtype.is_string or c.data is None or c.data.ndim != 1:
+                return False
+    except (KeyError, ValueError):
+        return False
+    return True
+
+
+# -- compiled form ----------------------------------------------------------
+
+def shape_class(table: Table) -> tuple:
+    """The compile key of a Table input: row count (padded chunk bucket),
+    names, and per-column (dtype, buffer shape, nullability) — everything
+    jax.jit would retrace on."""
+    return (
+        table.num_rows,
+        tuple(table.names) if table.names else None,
+        tuple((c.dtype,
+               None if c.data is None else (tuple(c.data.shape),
+                                            c.data.dtype.str),
+               c.validity is not None)
+              for c in table.columns),
+    )
+
+
+def _build_fn(seg: Segment, compiled: "CompiledSegment"):
+    """The single program a segment traces into.
+
+    ``fn(table, nvalid)``: rows >= nvalid are padding (chunk buckets).
+    Map segments return (table, live); agg segments return padded partial
+    aggregates + group-live mask — all device-resident, zero host syncs.
+    """
+    chain, agg = seg.chain, seg.agg
+
+    def fn(table: Table, nvalid):
+        from ..ops.aggregate import groupby_padded
+        from .executor import _eval_expr
+        compiled.traces += 1  # trace-time side effect: the no-recompile proof
+        live = jnp.arange(table.num_rows, dtype=jnp.int32) < nvalid
+        for nd in chain:
+            if isinstance(nd, Filter):
+                vals, valid = _eval_expr(nd.predicate, table)
+                m = jnp.asarray(vals, jnp.bool_)
+                if valid is not None:
+                    m = m & valid  # SQL semantics: NULL comparison drops
+                live = live & m
+            else:
+                table = table.select(list(nd.columns))
+        if agg is None:
+            return table, live
+        out_keys, out_aggs, ngroups = groupby_padded(
+            table, list(agg.keys), [(c, op) for c, op in agg.aggs],
+            row_mask=live)
+        npad = out_aggs[0].data.shape[0] if out_aggs else live.shape[0]
+        glive = jnp.arange(npad, dtype=jnp.int32) < ngroups
+        # dtypes are static metadata (CompiledSegment.key_dtypes); only the
+        # buffers cross the jit boundary
+        kdat = tuple(spec[2] for spec in out_keys)
+        kval = tuple(spec[3] for spec in out_keys)
+        return kdat, kval, tuple(out_aggs), glive, ngroups
+
+    return fn
+
+
+class CompiledSegment:
+    """One (segment, shape-class) entry: a jitted callable plus the trace
+    counter tests use to prove chunks reuse one executable."""
+
+    __slots__ = ("key", "segment", "key_dtypes", "jfn", "traces", "calls")
+
+    def __init__(self, key: tuple, segment: Segment, key_dtypes: tuple):
+        self.key = key
+        self.segment = segment
+        self.key_dtypes = key_dtypes
+        self.traces = 0
+        self.calls = 0
+        self.jfn = jax.jit(_build_fn(segment, self))
+
+    def __call__(self, table: Table, nvalid=None):
+        self.calls += 1
+        nv = jnp.int32(table.num_rows if nvalid is None else nvalid)
+        return self.jfn(table, nv)
+
+
+class SegmentCache:
+    """LRU: (segment fingerprint, shape-class) -> CompiledSegment.
+
+    The compiled-executable layer under ``PlanCache``: the plan cache
+    dedups optimization by logical fingerprint; this cache dedups XLA
+    executables by (structure, input shape).  Counters flow through
+    ``utils.tracing`` as ``engine.segment_cache.{hit,miss,eviction}``.
+    """
+
+    def __init__(self, maxsize: Optional[int] = None):
+        self._maxsize = None if maxsize is None else int(maxsize)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, CompiledSegment]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def maxsize(self) -> int:
+        # config-resolved late so SRJT_SEGMENT_CACHE + refresh() take
+        # effect on the live singleton (mirrors PlanCache)
+        return self._maxsize if self._maxsize is not None \
+            else config.segment_cache
+
+    def get(self, segment: Segment, table: Table) -> CompiledSegment:
+        key = (segment.fingerprint(), shape_class(table))
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                tracing.count("engine.segment_cache.hit")
+                return hit
+        key_dtypes = () if segment.agg is None else tuple(
+            table.column(k).dtype for k in segment.agg.keys)
+        compiled = CompiledSegment(key, segment, key_dtypes)
+        with self._lock:
+            racer = self._entries.get(key)
+            if racer is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                tracing.count("engine.segment_cache.hit")
+                return racer
+            self.misses += 1
+            tracing.count("engine.segment_cache.miss")
+            self._entries[key] = compiled
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                tracing.count("engine.segment_cache.eviction")
+            return compiled
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "size": len(self._entries), "maxsize": self.maxsize}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: process-wide compiled-segment cache (the executor's jit layer)
+SEGMENT_CACHE = SegmentCache()
+
+
+# -- boundary materialization ----------------------------------------------
+
+def run_map_segment(compiled: CompiledSegment, table: Table,
+                    nvalid=None) -> Table:
+    """Fused chain then ONE compaction at the breaker boundary (the only
+    host sync the whole chain pays, vs one per interpreted Filter)."""
+    from ..ops.selection import apply_boolean_mask
+    out, live = compiled(table, nvalid)
+    return apply_boolean_mask(out, live)
+
+
+def _compact_padded(key_dtypes, kdat, kval, out_aggs, ngroups,
+                    names) -> Table:
+    """groupby's padded->compact tail for fused outputs (fixed-width only,
+    which runtime eligibility guarantees)."""
+    ng = int(ngroups)  # the one host sync
+    cols = []
+    for dtype, data, valid in zip(key_dtypes, kdat, kval):
+        v = np.asarray(valid)[:ng]
+        cols.append(Column(dtype, data=jnp.asarray(np.asarray(data)[:ng]),
+                           validity=jnp.asarray(v) if not v.all() else None))
+    for c in out_aggs:
+        data = jnp.asarray(np.asarray(c.data)[:ng])
+        valid = None if c.validity is None else \
+            jnp.asarray(np.asarray(c.validity)[:ng])
+        cols.append(Column(c.dtype, data=data, validity=valid))
+    return Table(cols, names)
+
+
+def run_agg_segment(compiled: CompiledSegment, table: Table,
+                    nvalid=None) -> Table:
+    """Fused chain + aggregate, compacted to the final group rows."""
+    agg = compiled.segment.agg
+    kdat, kval, out_aggs, _glive, ngroups = compiled(table, nvalid)
+    return _compact_padded(compiled.key_dtypes, kdat, kval, out_aggs,
+                           ngroups, list(agg.keys) + list(agg.names))
+
+
+def combine_partials(partials: list, compiled: CompiledSegment) -> Table:
+    """Merge per-chunk padded partial aggregates into the final Table.
+
+    ``partials``: [(kdat, kval, out_aggs, glive, ngroups), ...] straight
+    off the fused agg program — still padded, never synced per chunk.
+    Two host syncs total, however many chunks streamed through: one
+    scalar ``max(ngroups)`` fetch to size the combine, one final
+    ``ngroups`` in the compaction tail.
+
+    The sizing sync matters: each partial is padded to its chunk's row
+    bucket (e.g. 16k slots for 12 live groups), and ``groupby_padded``
+    over num_chunks x bucket dead rows costs seconds.  Live groups are
+    packed at the FRONT of the padded arrays (that is what the [:ngroups]
+    compaction relies on), so slicing every partial to one power-of-two
+    capacity >= max(ngroups) preserves every live group, keeps the
+    combine's shape stable across runs (jit reuse), and shrinks it by
+    ~bucket/cap.
+    """
+    from ..ops.aggregate import groupby_padded
+    from .executor import _STREAM_COMBINE
+    agg = compiled.segment.agg
+    nk = len(agg.keys)
+    maxng = int(jnp.max(jnp.stack([jnp.asarray(p[4]) for p in partials])))
+    cap = 64
+    while cap < maxng:
+        cap *= 2
+
+    def cut(a):
+        return a[:cap] if a.shape[0] > cap else a
+
+    key_cols = [
+        Column(compiled.key_dtypes[i],
+               data=jnp.concatenate([cut(p[0][i]) for p in partials]),
+               validity=jnp.concatenate([cut(p[1][i]) for p in partials]))
+        for i in range(nk)]
+    agg_cols = []
+    for j in range(len(agg.aggs)):
+        datas = [cut(p[2][j].data) for p in partials]
+        valids = [None if p[2][j].validity is None
+                  else cut(p[2][j].validity) for p in partials]
+        validity = None if all(v is None for v in valids) else \
+            jnp.concatenate([jnp.ones(d.shape[0], jnp.bool_)
+                             if v is None else v
+                             for d, v in zip(datas, valids)])
+        agg_cols.append(Column(partials[0][2][j].dtype,
+                               data=jnp.concatenate(datas),
+                               validity=validity))
+    live = jnp.concatenate([cut(p[3]) for p in partials])
+    knames = [f"k{i}" for i in range(nk)]
+    anames = [f"a{j}" for j in range(len(agg.aggs))]
+    merged = Table(key_cols + agg_cols, knames + anames)
+    combine = [(anames[j], _STREAM_COMBINE[op])
+               for j, (_, op) in enumerate(agg.aggs)]
+    out_keys, out_aggs, ngroups = groupby_padded(merged, knames, combine,
+                                                 row_mask=live)
+    kdat = tuple(spec[2] for spec in out_keys)
+    kval = tuple(spec[3] for spec in out_keys)
+    return _compact_padded(compiled.key_dtypes, kdat, kval, out_aggs,
+                           ngroups, list(agg.keys) + list(agg.names))

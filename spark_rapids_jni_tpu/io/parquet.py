@@ -1114,7 +1114,8 @@ class ParquetChunkedReader:
                 # mark at the batch boundary
                 scope.checkpoint()
 
-    def _chunks_raw(self):
+    def _host_slices(self):
+        """Budget-bounded host-side chunk slices, pre device transfer."""
         for gi in range(self.file.num_row_groups):
             if self._group_pruned(gi):
                 self.groups_pruned += 1
@@ -1129,63 +1130,106 @@ class ParquetChunkedReader:
             step = max(1, self.limit // per_row)
             for a in range(0, nrows, step):
                 b = min(a + step, nrows)
-                sl = [h.slice(a, b) for h in hosts]
-                yield Table([h.to_column() for h in sl],
-                            [h.schema.name for h in sl])
+                yield [h.slice(a, b) for h in hosts]
+
+    def _chunks_raw(self):
+        for sl in self._host_slices():
+            yield Table([h.to_column() for h in sl],
+                        [h.schema.name for h in sl])
+
+    def _staged_chunks(self):
+        """(Table, n_rows) chunks on the packed-transfer path.
+
+        Fixed-width chunks ship as ONE staged transfer kept PADDED to the
+        power-of-two row bucket (io/staging.py): every same-schema chunk
+        lands in the same shape class, so the engine's fused segments
+        compile once and mask rows >= n_rows.  Ineligible schemas
+        (strings, lists, structs, DECIMAL128) fall back to per-column
+        transfers at natural size (n_rows == num_rows)."""
+        from .staging import stage_fixed_table
+        for sl in self._host_slices():
+            nrows = sl[0].num_rows
+            if all(h.values is not None and
+                   h.schema.dtype.id != dt.TypeId.DECIMAL128 for h in sl):
+                specs = [(h.schema.name, h.schema.dtype, h.values,
+                          h.validity) for h in sl]
+                yield stage_fixed_table(specs, padded=True)
+            else:
+                yield (Table([h.to_column() for h in sl],
+                             [h.schema.name for h in sl]), nrows)
+
+    def iter_staged(self, prefetch: int | None = None):
+        """Iterate ``(padded Table, n_rows)`` chunks, double-buffered.
+
+        The chunk-pipeline entry point: with depth >= 1 a producer thread
+        host-decodes AND stages (pack + device_put + unpack dispatch)
+        chunk k+1 while the consumer computes on chunk k — the decode and
+        transfer halves of the scan hide behind device compute.  Depth
+        defaults to the reader's ``prefetch``; 0 means serial."""
+        depth = self.prefetch if prefetch is None else int(prefetch)
+        gen = self._staged_chunks()
+        if depth <= 0:
+            yield from gen
+        else:
+            yield from _prefetched(gen, depth)
 
     def __iter__(self):
         if self.prefetch <= 0:
             yield from self._chunks()
             return
-        # Pipeline overlap (the per-thread-stream analog, SURVEY §2.3 "PP"):
-        # a worker thread decodes + stages chunk i+1..i+prefetch while the
-        # caller's device computation consumes chunk i.  jax dispatch is
-        # already async on the consumer side; this overlaps the HOST half
-        # (page decode, decompress) with it.  The queue bound keeps at most
-        # ``prefetch`` staged chunks of extra memory in flight.
-        import queue
-        import threading
+        yield from _prefetched(self._chunks(), self.prefetch)
 
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = threading.Event()
-        DONE, FAIL = object(), object()
 
-        def put(item) -> bool:  # False once the consumer abandoned us
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+def _prefetched(gen, depth: int):
+    """Pipeline overlap (the per-thread-stream analog, SURVEY §2.3 "PP"):
+    a worker thread produces item i+1..i+depth while the caller consumes
+    item i.  jax dispatch is already async on the consumer side; this
+    overlaps the HOST half (page decode, decompress, staging pack) with
+    it.  The queue bound keeps at most ``depth`` items of extra memory in
+    flight."""
+    import queue
+    import threading
 
-        def producer():
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    DONE, FAIL = object(), object()
+
+    def put(item) -> bool:  # False once the consumer abandoned us
+        while not stop.is_set():
             try:
-                for tbl in self._chunks():
-                    if not put(tbl):
-                        return
-                put(DONE)
-            except BaseException as e:  # surface decode errors to the consumer
-                put((FAIL, e))
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
+    def producer():
         try:
-            while True:
-                item = q.get()
-                if item is DONE:
-                    break
-                if isinstance(item, tuple) and len(item) == 2 \
-                        and item[0] is FAIL:
-                    raise item[1]
-                yield item
-        finally:
-            # early abandonment (LIMIT queries, consumer errors) must not
-            # leave the producer pinned on the bounded queue
-            stop.set()
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join(timeout=5)
+            for item in gen:
+                if not put(item):
+                    return
+            put(DONE)
+        except BaseException as e:  # surface decode errors to the consumer
+            put((FAIL, e))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                break
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] is FAIL:
+                raise item[1]
+            yield item
+    finally:
+        # early abandonment (LIMIT queries, consumer errors) must not
+        # leave the producer pinned on the bounded queue
+        stop.set()
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5)

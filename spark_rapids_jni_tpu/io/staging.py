@@ -151,29 +151,35 @@ def warm_plan_async(specs) -> None:
     threading.Thread(target=work, daemon=True).start()
 
 
-def stage_fixed_table(specs) -> Table:
+def stage_fixed_table(specs, padded: bool = False):
     """``specs``: list of (name, dtype, values_np, validity_np_or_None) for
     fixed-width dtypes only.  One host pack, ONE device transfer, one fused
     device unpack; returns the device Table.
 
     Rows are padded host-side to a power-of-two bucket so the jitted
     unpack's shapes (and hence its compile) are shared across file sizes;
-    outputs are sliced back to the true row count on device."""
+    outputs are sliced back to the true row count on device.
+
+    ``padded=True`` keeps the bucket-padded form and returns
+    ``(Table, n_rows)`` instead: pad rows carry zeroed values and False
+    validity.  This is the chunk-pipeline form — every same-schema chunk
+    shares ONE shape class, so fused plan segments (engine/segment.py)
+    compile once and mask rows ``>= n_rows`` instead of slicing."""
     blob = bytearray()
     plan = []
     posts = []  # (name, dtype, has_valid, n)
     n_rows = len(specs[0][2]) if specs else 0
-    padded = _bucket(n_rows)
+    bucket = _bucket(n_rows)
 
     def push(arr: np.ndarray, kind: str):
         arr = np.ascontiguousarray(arr)
-        if len(arr) < padded:
+        if len(arr) < bucket:
             arr = np.concatenate(
-                [arr, np.zeros(padded - len(arr), arr.dtype)])
+                [arr, np.zeros(bucket - len(arr), arr.dtype)])
         off = len(blob) // 4
         b = _pad4(arr.tobytes())
         blob.extend(b)
-        plan.append((kind, off, len(b) // 4, padded))
+        plan.append((kind, off, len(b) // 4, bucket))
 
     for name, dtype, values, validity in specs:
         size = np.dtype(dtype.storage).itemsize if not dtype.is_decimal \
@@ -194,7 +200,7 @@ def stage_fixed_table(specs) -> Table:
     cols, names = [], []
     ai = 0
     for name, dtype, has_valid, n in posts:
-        data = arrays[ai][:n]
+        data = arrays[ai] if padded else arrays[ai][:n]
         ai += 1
         storage = jnp.dtype(dtype.device_storage)
         if data.dtype != storage:
@@ -204,8 +210,10 @@ def stage_fixed_table(specs) -> Table:
                 data = data.astype(storage)
         valid = None
         if has_valid:
-            valid = arrays[ai][:n].astype(jnp.bool_)
+            v = arrays[ai]
+            valid = (v if padded else v[:n]).astype(jnp.bool_)
             ai += 1
         cols.append(Column(dtype, data=data, validity=valid))
         names.append(name)
-    return Table(cols, names)
+    out = Table(cols, names)
+    return (out, n_rows) if padded else out

@@ -89,8 +89,13 @@ def regex_matches(col: Column, pattern: str,
                 f"pattern {pattern!r} is outside the rewritable subset "
                 "(literal prefix/suffix/contains/equals)")
         # the host loop is O(rows) Python + a device round-trip per call —
-        # a silent 1000x cliff; name the pattern so it's diagnosable
+        # a silent 1000x cliff; name the pattern so it's diagnosable, and
+        # count it so fleet-wide fallback volume is measurable (the log
+        # line alone vanishes in aggregation)
+        from ..utils import tracing
         from ..utils.config import logger
+        tracing.count("ops.regex.host_fallback")
+        tracing.count(f"ops.regex.host_fallback.pattern.{pattern}")
         logger().warning(
             "regex_matches pattern %r is outside the rewritable subset; "
             "falling back to the per-row host loop over %d rows",

@@ -678,9 +678,20 @@ def _convert_to_rows_var(table: Table, max_batch_bytes: int) -> list[Column]:
         stop = int(np.searchsorted(ends_np, base_off + max_batch_bytes,
                                    side="right"))
         if stop < n:
-            aligned = start + (stop - start) // BATCH_ROW_ALIGN * \
-                BATCH_ROW_ALIGN
-            stop = aligned if aligned > start else stop
+            fit = stop - start
+            if fit >= BATCH_ROW_ALIGN:
+                # at least one whole aligned group fits the byte budget:
+                # align the cut down — the HARD contract middle batches keep
+                stop = start + fit // BATCH_ROW_ALIGN * BATCH_ROW_ALIGN
+            else:
+                # fewer than 32 rows fit: greedy maximality of searchsorted
+                # means one aligned group genuinely exceeds max_batch_bytes,
+                # the single case the contract exempts — enforce that this
+                # is why the cut is unaligned
+                group_end = min(start + BATCH_ROW_ALIGN, n)
+                assert int(ends_np[group_end - 1]) - base_off \
+                    > max_batch_bytes, "unaligned middle batch despite a " \
+                    "fitting aligned group"
         total_words = int(ends_np[stop - 1] - base_off) // 4
         row_off4 = ((row_ends[start:stop] - row_sizes[start:stop]
                      - base_off) // 4).astype(jnp.int32)
@@ -756,11 +767,12 @@ def convert_to_rows(table: Table, max_batch_bytes: int = MAX_BATCH_BYTES) -> lis
 
     Analog of ``RowConversion.convertToRows`` (RowConversion.java:101-108).
     Returns multiple columns when the packed output would exceed
-    ``max_batch_bytes`` (reference row_conversion.cu:476-511).  On the
-    fixed-width path, batch row counts are a multiple of 32 except possibly
-    the last; on the variable-width (STRING) path the 32-row alignment is
-    best-effort only — the byte-greedy batch splitter cuts wherever the
-    byte budget lands, so callers must not rely on it.
+    ``max_batch_bytes`` (reference row_conversion.cu:476-511).  Batch row
+    counts are a multiple of 32 except possibly the last — a hard contract
+    on both paths.  The fixed-width path raises when even one 32-row group
+    exceeds ``max_batch_bytes``; the variable-width (STRING) path cuts a
+    middle batch unaligned ONLY in that same oversized-group case (whenever
+    at least one aligned group fits the budget, the cut is aligned).
 
     STRING columns produce variable-width rows under the UnsafeRow-style
     contract documented above ``VarRowLayout`` (the reference snapshot

@@ -8,7 +8,11 @@ first-class: row blobs ride ``jax.lax.all_to_all`` over the ICI mesh inside
 """
 
 from .mesh import make_mesh, shard_table  # noqa: F401
-from .shuffle import shuffle_table_padded, partition_ids  # noqa: F401
+from .shuffle import (  # noqa: F401
+    partition_ids,
+    shuffle_chunks_pipelined,
+    shuffle_table_padded,
+)
 from .spill import shuffle_table_spilled  # noqa: F401
 from .distributed import (  # noqa: F401
     distributed_groupby, distributed_join, distributed_window,

@@ -30,7 +30,16 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:  # moved to the jax namespace in 0.5; experimental before that
+    from jax import shard_map
+except ImportError:  # pragma: no cover - jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_old(f, **kw)
 
 from ..columnar import Column, Table
 from ..ops.hash import murmur3_hash
@@ -340,3 +349,39 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
         from .stringplane import reassemble_strings
         out = reassemble_strings(out, plan)
     return out, ok, overflow
+
+
+def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
+                             capacity: int | None = None, depth: int = 1,
+                             axis: str = ROW_AXIS):
+    """Exchange a stream of table chunks with dispatch-ahead overlap.
+
+    The engine's double-buffered chunk pipeline applied to the shuffle
+    exchange: the all_to_all for chunk k+1 is DISPATCHED before chunk k is
+    yielded, so while the consumer's join/merge of chunk k runs (device
+    compute plus its host-side compaction sync), the next exchange is
+    already in the device queue — jax's async dispatch provides the
+    overlap; this generator just keeps up to ``depth`` exchanges in front
+    of the consumer.  ``depth=1`` is classic double buffering; ``depth=0``
+    degenerates to the serial exchange-then-merge loop.
+
+    ``chunks`` yields row-sharded Tables (or ``(Table, live_mask)`` pairs,
+    same contract as ``shuffle_table_padded``).  Pass ``capacity`` sized
+    from global counts so ONE compiled shuffle program serves the whole
+    stream; with ``capacity=None`` each chunk runs its own counts pass
+    (still correct, but differently-filled chunks may compile more than
+    one program).
+
+    Yields ``(padded Table, ok mask, overflow)`` per chunk, in order.
+    """
+    from collections import deque
+    inflight: deque = deque()
+    for item in chunks:
+        tbl, live = item if isinstance(item, tuple) else (item, None)
+        out = shuffle_table_padded(tbl, mesh, list(keys), capacity=capacity,
+                                   axis=axis, live=live)
+        inflight.append(out)
+        if len(inflight) > max(0, int(depth)):
+            yield inflight.popleft()
+    while inflight:
+        yield inflight.popleft()

@@ -11,6 +11,10 @@ environment flags read once at import:
 | ``SRJT_PALLAS``       | ``auto``| ``GPU_ARCHS`` (kernel backend selection) |
 | ``SRJT_LOG_LEVEL``    | ``WARNING`` | ``RMM_LOGGING_LEVEL`` (pom.xml:81) |
 | ``SRJT_LEAK_DEBUG``   | ``0``   | ``ai.rapids.refcount.debug`` (pom.xml:85,406) |
+| ``SRJT_FUSE``         | ``1``   | whole-stage codegen toggle (engine segment fusion) |
+| ``SRJT_PREFETCH``     | ``1``   | chunked-scan pipeline depth (0 = serial) |
+| ``SRJT_PLAN_CACHE``   | ``128`` | plan-cache capacity (spark.sql plan-cache size) |
+| ``SRJT_SEGMENT_CACHE``| ``256`` | compiled-segment cache capacity |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -30,12 +34,26 @@ def _bool_flag(name: str, default: bool) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _int_flag(name: str, default: int, minimum: int = 0) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return max(minimum, int(v.strip()))
+    except ValueError:
+        return default
+
+
 @dataclass
 class Config:
     trace: bool = False          # profiler annotations around ops
     pallas: str = "auto"         # "auto" | "on" | "off"
     log_level: str = "WARNING"
     leak_debug: bool = False     # bridge handle-leak tracking verbosity
+    fuse: bool = True            # engine whole-stage segment fusion
+    prefetch: int = 1            # chunked-scan pipeline depth (0 = serial)
+    plan_cache: int = 128        # PlanCache capacity (entries)
+    segment_cache: int = 256     # compiled-segment cache capacity (entries)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -44,6 +62,10 @@ class Config:
             pallas=os.environ.get("SRJT_PALLAS", "auto").strip().lower(),
             log_level=os.environ.get("SRJT_LOG_LEVEL", "WARNING").upper(),
             leak_debug=_bool_flag("SRJT_LEAK_DEBUG", False),
+            fuse=_bool_flag("SRJT_FUSE", True),
+            prefetch=_int_flag("SRJT_PREFETCH", 1),
+            plan_cache=_int_flag("SRJT_PLAN_CACHE", 128, minimum=1),
+            segment_cache=_int_flag("SRJT_SEGMENT_CACHE", 256, minimum=1),
         )
 
 
@@ -58,6 +80,10 @@ def refresh() -> Config:
     config.pallas = new.pallas
     config.log_level = new.log_level
     config.leak_debug = new.leak_debug
+    config.fuse = new.fuse
+    config.prefetch = new.prefetch
+    config.plan_cache = new.plan_cache
+    config.segment_cache = new.segment_cache
     logger().setLevel(config.log_level)
     return config
 

@@ -174,7 +174,8 @@ def test_plan_cache_hits_without_recompile(warehouse):
     tracing.reset_counters("engine.plan_cache")
 
     first = pc.get(q5_plan(root))
-    assert pc.stats() == {"hits": 0, "misses": 1, "size": 1, "maxsize": 128}
+    assert pc.stats() == {"hits": 0, "misses": 1, "size": 1,
+                          "maxsize": 128, "evictions": 0}
     r1 = as_dict(first.execute())
 
     # a structurally identical plan — even one that crossed the wire — must

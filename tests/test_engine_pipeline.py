@@ -1,0 +1,250 @@
+"""Fused plan-segment compilation + the double-buffered chunk pipeline.
+
+The engine's compiling executor (docs/ENGINE.md): Filter/Project/Aggregate
+chains between breakers run as single jitted segments cached by
+(fingerprint, shape-class), and chunked scans stream double-buffered with
+partials accumulating on device.  These tests pin the contracts the bench
+numbers rest on: fused == interpreted, streaming is deterministic across
+chunk sizes and prefetch depths, a segment compiles exactly once per shape
+class however many chunks flow through it, and both engine caches count
+hits/misses/evictions and honor their env-tuned capacities.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.engine import (
+    Aggregate, Filter, Join, PlanCache, Scan, Sort, col, execute, lit,
+    new_stats, optimize,
+)
+from spark_rapids_jni_tpu.engine import segment as sg
+from spark_rapids_jni_tpu.utils import config, tracing
+
+N_FACT = 3_000
+
+
+@pytest.fixture(scope="module")
+def warehouse(tmp_path_factory):
+    root = tmp_path_factory.mktemp("pipeline_wh")
+    rng = np.random.default_rng(11)
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 40, N_FACT).astype(np.int64)),
+        "v": pa.array(np.round(rng.uniform(-5.0, 50.0, N_FACT), 3)),
+        "w": pa.array(rng.integers(-100, 100, N_FACT).astype(np.int64)),
+    }), root / "fact.parquet", row_group_size=500)
+    pq.write_table(pa.table({
+        "dk": pa.array(np.arange(0, 30, dtype=np.int64)),
+    }), root / "dim.parquet")
+    # a tiny fact for the 1-row-chunk determinism sweep (300 one-row
+    # chunks off the big table would dominate suite time for no coverage)
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 7, 300).astype(np.int64)),
+        "v": pa.array(np.round(rng.uniform(-5.0, 50.0, 300), 3)),
+        "w": pa.array(rng.integers(-100, 100, 300).astype(np.int64)),
+    }), root / "small.parquet", row_group_size=100)
+    # single row group: the one geometry where a huge pass_read_limit
+    # really does yield the whole table as ONE chunk
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 7, 400).astype(np.int64)),
+        "v": pa.array(np.round(rng.uniform(-5.0, 50.0, 400), 3)),
+        "w": pa.array(rng.integers(-100, 100, 400).astype(np.int64)),
+    }), root / "whole.parquet", row_group_size=400)
+    return root
+
+
+def agg_plan(path, chunk_bytes=None):
+    """Filter chain -> Aggregate: the canonical fusable/streamable shape."""
+    return Aggregate(
+        Filter(Scan(str(path), chunk_bytes=chunk_bytes),
+               ("&", (">", col("v"), lit(0.0)),
+                ("<", col("w"), lit(90)))),
+        ["k"],
+        [("v", "sum"), ("v", "count"), ("w", "min"), ("w", "max"),
+         (None, "count_all")],
+        names=["s", "c", "lo", "hi", "n"])
+
+
+def as_sorted_rows(t: Table):
+    cols = [np.asarray(c.data, np.float64) for c in t.columns]
+    valids = [np.ones(t.num_rows, bool) if c.validity is None
+              else np.asarray(c.validity) for c in t.columns]
+    rows = sorted(zip(*[c.tolist() for c in cols],
+                      *[v.tolist() for v in valids]))
+    return rows
+
+
+def run(plan, **kw):
+    stats = new_stats()
+    out = execute(optimize(plan), stats, **kw)
+    return out, stats
+
+
+def test_fused_matches_interp_on_join_plan(warehouse):
+    """Multi-node plan with a join breaker: fused segments (chain below and
+    aggregate above the join) must reproduce the interpreter exactly."""
+    kept = Filter(Join(Scan(str(warehouse / "fact.parquet")),
+                       Scan(str(warehouse / "dim.parquet")),
+                       ["k"], ["dk"], how="semi"),
+                  ("&", (">", col("v"), lit(0.0)), (">=", col("k"), lit(2))))
+    plan = Sort(Aggregate(kept, ["k"], [("v", "sum"), ("w", "max")],
+                          names=["s", "m"]), (("k", True),))
+    fused_out, fused_stats = run(plan, fused=True)
+    interp_out, interp_stats = run(plan, fused=False)
+    assert fused_stats["fused_segments"] >= 1
+    assert interp_stats["fused_segments"] == 0
+    assert as_sorted_rows(fused_out) == as_sorted_rows(interp_out)
+
+
+@pytest.mark.parametrize("path,chunk_bytes,expect_many", [
+    ("small.parquet", 24, True),          # 1 row per chunk
+    ("fact.parquet", 1_000, True),        # unaligned (~41 rows)
+    ("fact.parquet", 24 * 1_024, True),   # bucket-aligned 1024-row chunks
+    ("whole.parquet", 1 << 30, False),    # whole table in one chunk
+])
+def test_streaming_determinism_across_chunk_sizes(warehouse, path,
+                                                  chunk_bytes, expect_many):
+    """The double-buffered streaming aggregate equals the single-shot
+    result for every chunk geometry: 1-row, unaligned, bucket-aligned and
+    whole-table chunks."""
+    single, _ = run(agg_plan(warehouse / path), fused=True)
+    streamed, stats = run(agg_plan(warehouse / path, chunk_bytes=chunk_bytes),
+                          fused=True, prefetch=2)
+    assert stats["streamed"] and stats["pipelined"]
+    assert (stats["chunks"] > 1) == expect_many
+    assert as_sorted_rows(streamed) == as_sorted_rows(single)
+    # and the serial (prefetch=0) loop is bit-identical to the pipelined one
+    serial, sstats = run(agg_plan(warehouse / path, chunk_bytes=chunk_bytes),
+                         fused=True, prefetch=0)
+    assert not sstats["pipelined"]
+    assert as_sorted_rows(serial) == as_sorted_rows(streamed)
+
+
+def test_segment_traced_once_across_chunks(warehouse):
+    """One compiled program serves every same-shape-class chunk: the python
+    side-effect counter inside the traced fn ticks once, while the call
+    counter ticks per chunk."""
+    sg.SEGMENT_CACHE.clear()
+    _, stats = run(agg_plan(warehouse / "fact.parquet", chunk_bytes=24 * 512),
+                   fused=True, prefetch=1)
+    assert stats["chunks"] > 1 and stats["fused_segments"] >= 1
+    compiled = list(sg.SEGMENT_CACHE._entries.values())
+    called = [c for c in compiled if c.calls]
+    assert called, "streaming run must have exercised the segment cache"
+    assert all(c.traces == 1 for c in called)
+    assert max(c.calls for c in called) == stats["chunks"]
+
+
+def test_segment_cache_counters_and_env_capacity(warehouse):
+    """hit/miss/eviction counters tick (attrs + tracing registry) and
+    SRJT_SEGMENT_CACHE caps a fresh cache via config refresh()."""
+    from spark_rapids_jni_tpu.engine.segment import (SegmentCache,
+                                                     build_segment,
+                                                     parent_counts)
+    t = Table([Column.from_numpy(np.arange(8, dtype=np.int64)),
+               Column.from_numpy(np.ones(8))], ["k", "v"])
+
+    def seg_for(cut):
+        root = Aggregate(Filter(Scan("mem"), (">", col("v"), lit(cut))),
+                         ["k"], [("v", "sum")], names=["s"])
+        return build_segment(root, parent_counts(root))
+
+    os.environ["SRJT_SEGMENT_CACHE"] = "1"
+    config.refresh()
+    tracing.reset_counters("engine.segment_cache")
+    try:
+        cache = SegmentCache()  # capacity resolves from live config
+        assert cache.maxsize == 1
+        cache.get(seg_for(0.0), t)
+        cache.get(seg_for(0.0), t)            # same fingerprint+shape: hit
+        cache.get(seg_for(1.0), t)            # new fingerprint: evicts
+        st = cache.stats()
+        assert (st["hits"], st["misses"], st["evictions"]) == (1, 2, 1)
+        assert tracing.counter_value("engine.segment_cache.hit") == 1
+        assert tracing.counter_value("engine.segment_cache.miss") == 2
+        assert tracing.counter_value("engine.segment_cache.eviction") == 1
+    finally:
+        del os.environ["SRJT_SEGMENT_CACHE"]
+        config.refresh()
+    assert SegmentCache().maxsize == 256  # default restored
+
+
+def test_plan_cache_env_capacity_and_eviction_counter(warehouse):
+    tracing.reset_counters("engine.plan_cache")
+    os.environ["SRJT_PLAN_CACHE"] = "2"
+    config.refresh()
+    try:
+        pc = PlanCache()
+        assert pc.maxsize == 2
+        for cut in (1, 2, 3):
+            pc.get(Filter(Scan(str(warehouse / "dim.parquet")),
+                          (">", col("dk"), lit(cut))))
+        assert pc.evictions == 1
+        assert pc.stats()["evictions"] == 1
+        assert tracing.counter_value("engine.plan_cache.eviction") == 1
+    finally:
+        del os.environ["SRJT_PLAN_CACHE"]
+        config.refresh()
+    assert PlanCache().maxsize == 128  # default restored
+
+
+def test_prefetched_staged_reader_equals_serial(warehouse):
+    """iter_staged with a producer thread yields the same (padded chunk,
+    nvalid) stream as the serial generator, in order."""
+    from spark_rapids_jni_tpu.io import ParquetChunkedReader
+
+    def mk():
+        return ParquetChunkedReader(str(warehouse / "fact.parquet"),
+                                    pass_read_limit=24 * 512)
+
+    serial = list(mk().iter_staged(prefetch=0))
+    piped = list(mk().iter_staged(prefetch=3))
+    assert len(serial) == len(piped) > 1
+    for (ts, ns), (tp, np_) in zip(serial, piped):
+        assert ns == np_ and ts.num_rows == tp.num_rows
+        for cs, cp in zip(ts.columns, tp.columns):
+            np.testing.assert_array_equal(np.asarray(cs.data),
+                                          np.asarray(cp.data))
+
+
+NDEV = 8
+
+
+def test_pipelined_shuffle_matches_serial_and_is_lossless():
+    """shuffle_chunks_pipelined: dispatch-ahead exchange of a chunk stream
+    is per-chunk identical to the serial loop and loses no rows."""
+    from spark_rapids_jni_tpu.parallel import (make_mesh, shard_table,
+                                               shuffle_chunks_pipelined)
+    mesh = make_mesh(NDEV)
+    rng = np.random.default_rng(3)
+    n, nchunks = 1024, 4
+    k = rng.integers(0, 50, n).astype(np.int64)
+    v = rng.uniform(-1.0, 1.0, n)
+
+    def chunks():
+        for i in range(nchunks):
+            s = slice(i * n // nchunks, (i + 1) * n // nchunks)
+            yield shard_table(Table([Column.from_numpy(k[s]),
+                                     Column.from_numpy(v[s])],
+                                    ["k", "v"]), mesh)
+
+    serial = list(shuffle_chunks_pipelined(chunks(), mesh, ["k"],
+                                           capacity=256, depth=0))
+    piped = list(shuffle_chunks_pipelined(chunks(), mesh, ["k"],
+                                          capacity=256, depth=2))
+    assert len(serial) == len(piped) == nchunks
+    got = []
+    for (ot, ok, ovf), (pt, pok, povf) in zip(serial, piped):
+        assert int(ovf) == 0 and int(povf) == 0
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(pok))
+        for cs, cp in zip(ot.columns, pt.columns):
+            np.testing.assert_array_equal(np.asarray(cs.data),
+                                          np.asarray(cp.data))
+        m = np.asarray(ok)
+        got += list(zip(ot["k"].to_numpy()[m].tolist(),
+                        ot["v"].to_numpy()[m].tolist()))
+    assert sorted(got) == sorted(zip(k.tolist(), v.tolist()))

@@ -367,3 +367,41 @@ def test_var_all_string_schema():
     back = convert_from_rows(blobs[0], t.dtypes())
     assert back.columns[0].to_pylist() == ["abc", "", "longer-string", None]
     assert back.columns[1].to_pylist() == ["x", "yy", None, "zzzz"]
+
+
+def test_var_middle_batches_keep_32_alignment():
+    """The HARD alignment contract on the variable-width path: whenever at
+    least one whole 32-row group fits max_batch_bytes, the middle-batch cut
+    is aligned down to a 32-row boundary (convert_to_rows docstring)."""
+    table, cols_np, schema = make_var_table(600, seed=5)
+    blobs = convert_to_rows(table, max_batch_bytes=8192)
+    assert len(blobs) > 2
+    for b in blobs[:-1]:
+        assert b.size % 32 == 0, "middle batch not 32-row aligned"
+        assert int(np.asarray(b.offsets)[-1]) <= 8192
+    parts = [convert_from_rows(b, schema) for b in blobs]
+    assert sum(p.num_rows for p in parts) == 600
+
+
+def test_var_oversized_group_is_the_only_unaligned_exemption():
+    """The one legal unaligned middle cut: a single 32-row group whose bytes
+    exceed max_batch_bytes (here every row is ~1KB, so any 32 consecutive
+    rows blow a 4KB budget).  Batches go out unaligned, nothing is lost."""
+    n, cap = 40, 4096
+    strs = ["x" * 1000 for _ in range(n)]
+    table = Table([
+        Column.from_pylist(strs, dtype=dt.STRING),
+        Column.from_numpy(np.arange(n, dtype=np.int64)),
+    ])
+    blobs = convert_to_rows(table, max_batch_bytes=cap)
+    assert len(blobs) > 1
+    sizes = [b.size for b in blobs]
+    assert any(s % 32 for s in sizes[:-1])  # unaligned middle cuts happened
+    # the exemption's precondition really holds: rows are so wide that no
+    # aligned group could have fit the budget
+    per_row = int(np.asarray(blobs[0].offsets)[1])
+    assert 32 * per_row > cap
+    parts = [convert_from_rows(b, table.dtypes()) for b in blobs]
+    assert sum(p.num_rows for p in parts) == n
+    got = sum((p.columns[0].to_pylist() for p in parts), [])
+    assert got == strs
