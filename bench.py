@@ -814,6 +814,118 @@ def bench_engine_pipeline(n=600_000, chunk_bytes=512_000, smoke=False):
     }
 
 
+def bench_engine_join(n=400_000, chunk_bytes=512_000, smoke=False):
+    """Streamed probe join + streaming top-k vs their PR 2 fallbacks.
+
+    Two A/B pairs on the LOCAL executor, interleaved min-of-reps like
+    ``bench_engine_pipeline``:
+
+    - chunked probe join: the fused path prepares the build side (hash +
+      stable sort) ONCE via ``BUILD_CACHE`` and probes every chunk inside
+      one jitted program, vs the interpreted per-chunk loop that re-runs
+      the whole ``inner_join`` — build sort included — on every chunk.
+      The cold-cache counter contract (``hits == chunks - 1``) is asserted
+      here, not just in tests, so the bench can't silently measure the
+      wrong path.
+    - ORDER BY ... LIMIT k: the streamed ``TopK`` (capacity-k device
+      buffer merged per chunk) vs materializing + fully sorting the table
+      (``SRJT_TOPK=0`` semantics), same optimized plan.
+    """
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.engine import (Aggregate, BUILD_CACHE, Filter,
+                                             Join, Limit, Scan, Sort, col,
+                                             lit, optimize)
+    from spark_rapids_jni_tpu.ops.order import SortKey
+    from spark_rapids_jni_tpu.ops.selection import sort_table
+    from spark_rapids_jni_tpu.utils.config import config as cfg
+    from spark_rapids_jni_tpu.utils.config import refresh
+
+    rng = np.random.default_rng(17)
+    with tempfile.TemporaryDirectory() as tmp:
+        root = os.path.join(tmp, "wh")
+        os.mkdir(root)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 2_000, n).astype(np.int64)),
+            "v": pa.array(rng.uniform(-5.0, 50.0, n)),
+        }), os.path.join(root, "fact.parquet"),
+            row_group_size=max(1, n // 8))
+        pq.write_table(pa.table({
+            "dk": pa.array(np.arange(0, 2_000, dtype=np.int64)),
+            "dv": pa.array((np.arange(0, 2_000) % 16).astype(np.int64)),
+        }), os.path.join(root, "dim.parquet"))
+
+        def fact_scan():
+            return Filter(Scan(os.path.join(root, "fact.parquet"),
+                               chunk_bytes=chunk_bytes),
+                          (">", col("v"), lit(0.0)))
+
+        j_opt = optimize(Aggregate(
+            Join(fact_scan(), Scan(os.path.join(root, "dim.parquet")),
+                 ["k"], ["dk"], how="inner"),
+            ["dv"], [("v", "sum"), ("v", "count")], names=["s", "c"]))
+        t_opt = optimize(Limit(Sort(fact_scan(), (("v", False),)), 32))
+
+        def sorted_by_key(t):
+            return sort_table(t, [SortKey(t[t.names[0]], ascending=True)])
+
+        reps = 1 if smoke else 3
+        _run_plan(j_opt, fused=True, prefetch=0)   # compile warm-up
+        _run_plan(j_opt, fused=False, prefetch=0)  # warm interp loop
+        t_cached = t_perchunk = float("inf")
+        out_c = out_p = st_c = None
+        for _ in range(reps):
+            dt, out_c, st_c = _run_plan(j_opt, fused=True, prefetch=0)
+            t_cached = min(t_cached, dt)
+            dt, out_p, _ = _run_plan(j_opt, fused=False, prefetch=0)
+            t_perchunk = min(t_perchunk, dt)
+        join_match = _tables_match(sorted_by_key(out_c), sorted_by_key(out_p))
+
+        # cold-cache counter contract: exactly one miss, then a hit per
+        # remaining chunk
+        BUILD_CACHE.clear()
+        h0, m0 = BUILD_CACHE.hits, BUILD_CACHE.misses
+        _, _, st_cold = _run_plan(j_opt, fused=True, prefetch=0)
+        counters_ok = (st_cold["fused_segments"] == 1
+                       and BUILD_CACHE.misses - m0 == 1
+                       and BUILD_CACHE.hits - h0 == st_cold["chunks"] - 1)
+
+        _run_plan(t_opt, fused=True, prefetch=0)  # warm-up
+        t_stream = t_full = float("inf")
+        out_ts = out_tf = st_ts = None
+        for _ in range(reps):
+            dt, out_ts, st_ts = _run_plan(t_opt, fused=True, prefetch=0)
+            t_stream = min(t_stream, dt)
+            cfg.topk = False
+            try:
+                dt, out_tf, _ = _run_plan(t_opt, fused=True, prefetch=0)
+            finally:
+                refresh()
+            t_full = min(t_full, dt)
+        # ordered compare: tie order is part of the top-k contract
+        topk_match = _tables_match(out_ts, out_tf)
+
+    return {
+        "join_cached_build_ms": t_cached * 1e3,
+        "join_per_chunk_build_ms": t_perchunk * 1e3,
+        "cached_vs_per_chunk": (t_perchunk / t_cached
+                                if t_cached else None),
+        "topk_stream_ms": t_stream * 1e3,
+        "topk_full_sort_ms": t_full * 1e3,
+        "topk_vs_full_sort": t_full / t_stream if t_stream else None,
+        "chunks": st_cold["chunks"],
+        "join_streamed_fused": bool(st_c["fused_segments"]),
+        "topk_streamed": bool(st_ts["topk"]),
+        "build_cache_counters_ok": bool(counters_ok),
+        "results_match": bool(join_match and topk_match),
+        "build_cache": {k: v for k, v in BUILD_CACHE.stats().items()
+                        if k != "maxsize"},
+    }
+
+
 def smoke():
     """``bench.py --smoke``: tiny shapes through the fused + pipelined
     paths end-to-end, correctness-only (no timing assertions) — wired into
@@ -826,7 +938,15 @@ def smoke():
                       "ok": ok,
                       "chunks": res["chunks"] if res else None,
                       "segment_cache": res["segment_cache"] if res else None}))
-    return 0 if ok else 1
+    jres = bench_engine_join(n=20_000, chunk_bytes=48_000, smoke=True)
+    jok = bool(jres and jres["results_match"] and jres["join_streamed_fused"]
+               and jres["topk_streamed"] and jres["build_cache_counters_ok"]
+               and jres["chunks"] > 1)
+    print(json.dumps({"metric": "engine_join_smoke",
+                      "ok": jok,
+                      "chunks": jres["chunks"] if jres else None,
+                      "build_cache": jres["build_cache"] if jres else None}))
+    return 0 if (ok and jok) else 1
 
 
 def main():
@@ -842,6 +962,7 @@ def main():
     smj = bench_distributed_join()
     eng = bench_engine_q5()
     pipe = bench_engine_pipeline()
+    ejoin = bench_engine_join()
 
     # vs_baseline is measured/PINNED (BENCH_BASELINES.json), so the ratio is
     # comparable across rounds; the live re-measure of each baseline is
@@ -969,6 +1090,30 @@ def main():
                         "~1.0 is expected there until a real accelerator "
                         "link is in the loop"}}
                if pipe else {}),
+            **({"engine_join": {
+                "join_cached_build_ms": round(
+                    ejoin["join_cached_build_ms"], 1),
+                "join_per_chunk_build_ms": round(
+                    ejoin["join_per_chunk_build_ms"], 1),
+                "cached_vs_per_chunk": round(
+                    ejoin["cached_vs_per_chunk"], 3),
+                "topk_stream_ms": round(ejoin["topk_stream_ms"], 1),
+                "topk_full_sort_ms": round(ejoin["topk_full_sort_ms"], 1),
+                "topk_vs_full_sort": round(ejoin["topk_vs_full_sort"], 3),
+                "chunks": ejoin["chunks"],
+                "build_cache_counters_ok":
+                    ejoin["build_cache_counters_ok"],
+                "results_match": ejoin["results_match"],
+                "build_cache": ejoin["build_cache"],
+                "note": "LOCAL executor. cached_vs_per_chunk: streamed "
+                        "inner join with the build side prepared once "
+                        "(BUILD_CACHE, fused probe per chunk) vs the "
+                        "interpreted loop re-hashing + re-sorting the "
+                        "build every chunk (>1 means cached wins). "
+                        "topk_vs_full_sort: streamed capacity-k TopK vs "
+                        "materialize + full sort + slice on the same "
+                        "optimized plan (>1 means streaming wins)"}}
+               if ejoin else {}),
         },
     }))
 

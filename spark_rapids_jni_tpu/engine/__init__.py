@@ -8,8 +8,13 @@ pruning (optimizer.py), then ``execute`` it on the ops/io layers
 single jitted segments cached by (fingerprint, shape-class) in
 ``SEGMENT_CACHE`` (segment.py), and chunked scans stream double-buffered —
 a producer thread decodes+stages chunk k+1 while chunk k computes, partials
-accumulating on device with no per-chunk sync.  ``PlanCache`` (cache.py)
-lets repeat queries skip optimization and hit the warm jit caches.
+accumulating on device with no per-chunk sync.  Streamed probe joins ride
+the same segments: a scan-independent build side is hashed + sorted once
+per execution (``BUILD_CACHE``, cache.py) and enters the chunk program as
+a pytree input; ``Limit(Sort(...))`` fuses into a ``TopK`` node executed
+as a per-chunk partial top-k over order-preserving u64 keys.  ``PlanCache``
+(cache.py) lets repeat queries skip optimization and hit the warm jit
+caches.
 ``docs/ENGINE.md`` has the full design, including the bridge's one-message
 ``PLAN_EXECUTE`` wire format.
 """
@@ -23,6 +28,7 @@ from .plan import (  # noqa: F401
     Project,
     Scan,
     Sort,
+    TopK,
     col,
     deserialize,
     expr_columns,
@@ -31,11 +37,17 @@ from .plan import (  # noqa: F401
 )
 from .optimizer import optimize, output_names  # noqa: F401
 from .executor import execute, new_stats  # noqa: F401
-from .cache import CompiledPlan, PlanCache  # noqa: F401
+from .cache import (  # noqa: F401
+    BUILD_CACHE,
+    BuildCache,
+    CompiledPlan,
+    PlanCache,
+)
 from .segment import (  # noqa: F401
     SEGMENT_CACHE,
     CompiledSegment,
     Segment,
     SegmentCache,
     build_segment,
+    build_stream_segment,
 )

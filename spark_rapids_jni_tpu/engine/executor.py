@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..columnar import Table
 from ..utils.tracing import op_scope
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort)
+                   Sort, TopK)
 
 #: aggregate ops with a (merge-op) decomposition usable for per-chunk
 #: partials; value = op that combines partial results
@@ -98,7 +98,7 @@ def _filter_table(table: Table, predicate) -> Table:
 def new_stats() -> dict:
     return {"row_groups_pruned": 0, "row_groups_read": 0,
             "chunks": 0, "streamed": False, "nodes": 0,
-            "fused_segments": 0, "pipelined": False}
+            "fused_segments": 0, "pipelined": False, "topk": False}
 
 
 # -- execution context -----------------------------------------------------
@@ -146,26 +146,19 @@ def _depends_on(node: PlanNode, target: PlanNode, memo: dict) -> bool:
     return r
 
 
-def _stream_scan_of(agg: Aggregate) -> Optional[Scan]:
-    """The single chunked parquet Scan this Aggregate can stream over.
-
-    Requires: every agg op decomposable, non-empty grouping keys, exactly
-    one chunked scan in the subtree, and a path to it made only of
-    Filter/Project/Join nodes where the scan feeds exactly one join side.
-    """
-    if not agg.keys:
-        return None
-    if any(op not in _STREAM_COMBINE for _, op in agg.aggs):
-        return None
+def _single_chunked_scan(root: PlanNode) -> Optional[Scan]:
+    """The single chunked parquet Scan under ``root`` reachable through
+    Filter/Project/Join nodes only (scan feeding exactly one join side) —
+    the stream axis both partial aggregation and partial top-k need."""
     from .plan import topo_nodes
-    scans = [n for n in topo_nodes(agg.child)
+    scans = [n for n in topo_nodes(root)
              if isinstance(n, Scan) and n.chunk_bytes
              and n.format == "parquet"]
     if len(scans) != 1:
         return None
     scan = scans[0]
     dep: dict = {}
-    node = agg.child
+    node = root
     while node is not scan:
         if isinstance(node, (Filter, Project)):
             node = node.child
@@ -178,6 +171,19 @@ def _stream_scan_of(agg: Aggregate) -> Optional[Scan]:
         else:
             return None  # Sort/Limit/Aggregate between: not decomposable
     return scan
+
+
+def _stream_scan_of(agg: Aggregate) -> Optional[Scan]:
+    """The single chunked parquet Scan this Aggregate can stream over.
+
+    Requires: every agg op decomposable, non-empty grouping keys, and a
+    ``_single_chunked_scan`` under the child.
+    """
+    if not agg.keys:
+        return None
+    if any(op not in _STREAM_COMBINE for _, op in agg.aggs):
+        return None
+    return _single_chunked_scan(agg.child)
 
 
 # -- the walk --------------------------------------------------------------
@@ -289,17 +295,44 @@ def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
             from ..ops.selection import slice_table
             t = _exec(node.child, memo, stats, ctx)
             out = slice_table(t, 0, min(node.n, t.num_rows))
+        elif isinstance(node, TopK):
+            out = _exec_topk(node, memo, stats, ctx)
         else:
             raise TypeError(f"unknown plan node {type(node).__name__}")
     memo[id(node)] = out
     return out
 
 
+def _precompute_independent(root: PlanNode, scan: Scan, memo: dict,
+                            stats: dict, ctx: _ExecCtx) -> None:
+    """Compute every scan-independent subtree once, into the shared memo,
+    so per-chunk re-walks only redo scan-dependent nodes."""
+    from .plan import topo_nodes
+    dep: dict = {}
+    for n in topo_nodes(root):
+        if n is not root and not _depends_on(n, scan, dep) \
+                and id(n) not in memo:
+            _exec(n, memo, stats, ctx)
+
+
+def _get_builds(joins: tuple, build_tables: tuple) -> tuple:
+    """The per-chunk BUILD_CACHE access: one ``get`` per join per chunk —
+    the first chunk of a cold stream misses and pays the hash + sort,
+    every later chunk hits (``hits == chunks - 1``)."""
+    from ..ops.join import prepare_build
+    from .cache import BUILD_CACHE
+    return tuple(
+        BUILD_CACHE.get(j.fingerprint(), bt,
+                        lambda j=j, bt=bt: prepare_build(
+                            bt, list(j.right_keys)))
+        for j, bt in zip(joins, build_tables))
+
+
 def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                    stats: dict, ctx: _ExecCtx) -> Table:
     """Per-chunk partial aggregation over the one chunked scan.
 
-    Two compounding upgrades over the PR 1 interpreter loop:
+    Three compounding upgrades over the PR 1 interpreter loop:
 
     - **Double-buffered pipeline** (``ctx.prefetch > 0``): the reader's
       producer thread host-decodes and stages chunk k+1 while the device
@@ -310,22 +343,20 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
       bucket, so one jitted segment (filters -> masked partial groupby)
       serves every chunk with zero per-chunk host syncs; padded partials
       accumulate on device and merge with ONE combine groupby at the end.
-      A Join on the path (dimension-table probe) falls back to the
+    - **Fused probe joins** (``config.fuse_join``): a Join on the path
+      whose build side is scan-independent joins the segment instead of
+      breaking it — the build is hashed + sorted once per execution
+      (``BUILD_CACHE``) and enters the chunk program as a pytree input.
+      Non-unique build hashes or ineligible schemas fall back to the
       interpreted per-chunk loop, which still pipelines.
     """
     from ..io import ParquetChunkedReader
     from ..ops.aggregate import groupby
     from ..ops.selection import concat_tables
+    from ..utils.config import config
     from . import segment as sg
-    from .plan import topo_nodes
 
-    # compute every scan-independent subtree once, into the shared memo,
-    # so per-chunk re-walks only redo scan-dependent nodes
-    dep: dict = {}
-    for n in topo_nodes(agg.child):
-        if n is not agg.child and not _depends_on(n, scan, dep) \
-                and id(n) not in memo:
-            _exec(n, memo, stats, ctx)
+    _precompute_independent(agg.child, scan, memo, stats, ctx)
 
     cols = list(scan.columns) if scan.columns else None
     reader = ParquetChunkedReader(
@@ -336,7 +367,8 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
 
     seg = None
     if ctx.fuse:
-        cand = sg.build_segment(agg, ctx.nparents)
+        cand = sg.build_stream_segment(agg, scan, ctx.nparents,
+                                       fuse_join=config.fuse_join)
         if cand is not None and cand.input is scan \
                 and sg.worthwhile(cand, streaming=True):
             seg = cand
@@ -344,33 +376,53 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
     partials: list = []          # interpreted path: compacted Tables
     fused: list = []             # fused path: padded device partials
     fused_compiled = None
-    if seg is not None:
-        it = reader.iter_staged()
-        first = next(it, None)
-        if first is not None and not sg.runtime_eligible(seg, first[0]):
-            # schema veto (strings in filter/agg position): interpret,
-            # still pipelined through the same staged iterator
-            from ..ops.selection import slice_table
-            seg = None
-            for chunk, nvalid in _chain_one(first, it):
-                if nvalid < chunk.num_rows:
-                    chunk = slice_table(chunk, 0, nvalid)
+    try:
+        if seg is not None:
+            joins = seg.joins()
+            build_tables = tuple(memo[id(j.right)] for j in joins)
+            it = reader.iter_staged()
+            first = next(it, None)
+            veto = False
+            first_preps: tuple = ()
+            if first is not None:
+                if not sg.stream_runtime_eligible(seg, first[0],
+                                                  build_tables):
+                    veto = True  # schema veto: strings/nested in compute
+                else:
+                    # this access stands in for chunk 1's per-chunk get
+                    first_preps = _get_builds(joins, build_tables)
+                    if any(not p.unique for p in first_preps):
+                        # duplicate 32-bit build hashes: the <=1-candidate
+                        # probe shape doesn't hold; interpret instead
+                        veto = True
+            if veto:
+                from ..ops.selection import slice_table
+                seg = None
+                for chunk, nvalid in _chain_one(first, it):
+                    if nvalid < chunk.num_rows:
+                        chunk = slice_table(chunk, 0, nvalid)
+                    partials.extend(_stream_partial(agg, scan, chunk, memo,
+                                                    stats, ctx))
+            else:
+                stats["nodes"] += len(seg.chain)  # agg counted by _exec
+                preps = first_preps
+                for chunk, nvalid in _chain_one(first, it) \
+                        if first is not None else ():
+                    stats["chunks"] += 1
+                    if fused:  # chunks after the first hit the cache
+                        preps = _get_builds(joins, build_tables)
+                    fused_compiled = sg.SEGMENT_CACHE.get(seg, chunk,
+                                                          build_tables)
+                    with op_scope("engine.fused_segment"):
+                        fused.append(fused_compiled(chunk, nvalid, preps))
+                if fused:
+                    stats["fused_segments"] += 1
+        else:
+            for chunk in reader:
                 partials.extend(_stream_partial(agg, scan, chunk, memo,
                                                 stats, ctx))
-        else:
-            stats["nodes"] += len(seg.chain)  # agg itself counted by _exec
-            for chunk, nvalid in _chain_one(first, it) \
-                    if first is not None else ():
-                stats["chunks"] += 1
-                fused_compiled = sg.SEGMENT_CACHE.get(seg, chunk)
-                with op_scope("engine.fused_segment"):
-                    fused.append(fused_compiled(chunk, nvalid))
-            if fused:
-                stats["fused_segments"] += 1
-    else:
-        for chunk in reader:
-            partials.extend(_stream_partial(agg, scan, chunk, memo,
-                                            stats, ctx))
+    finally:
+        reader.close()
     stats["row_groups_pruned"] += reader.groups_pruned
     stats["row_groups_read"] += reader.groups_read
 
@@ -380,7 +432,7 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
         # everything pruned/filtered: run the plan once on an empty chunk
         # so the output schema still comes out right
         from ..io import ParquetFile
-        sub = dict(memo)
+        sub = _ChunkMemo(memo)
         sub[id(scan)] = ParquetFile(scan.path).empty_table(cols)
         return _groupby(_exec(agg.child, sub, stats, ctx), agg)
 
@@ -395,15 +447,114 @@ def _chain_one(first, rest):
     yield from rest
 
 
+class _ChunkMemo(dict):
+    """Per-chunk memo overlay: scan-dependent results land here (a small
+    dict rebuilt each chunk), scan-independent ones resolve from the
+    shared base memo — replacing the old per-chunk ``dict(memo)`` copy,
+    which was O(plan size) per chunk."""
+
+    __slots__ = ("base",)
+
+    def __init__(self, base: dict):
+        super().__init__()
+        self.base = base
+
+    def __contains__(self, k):
+        return dict.__contains__(self, k) or k in self.base
+
+    def __getitem__(self, k):
+        try:
+            return dict.__getitem__(self, k)
+        except KeyError:
+            return self.base[k]
+
+
 def _stream_partial(agg: Aggregate, scan: Scan, chunk: Table, memo: dict,
                     stats: dict, ctx: _ExecCtx) -> list:
     """Interpreted per-chunk partial: re-walk the scan-dependent subtree
     with the chunk standing in for the scan, then a compacting groupby."""
     stats["chunks"] += 1
-    sub = dict(memo)
+    sub = _ChunkMemo(memo)
     sub[id(scan)] = chunk
     t = _exec(agg.child, sub, stats, ctx)
     return [_groupby(t, agg)] if t.num_rows else []
+
+
+def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
+    """ORDER BY ... LIMIT k without materializing the full table.
+
+    When the child streams over one chunked scan (``config.topk``), each
+    chunk's survivors are ranked by their order-preserving u64 key words
+    (ops/order.py) plus a global arrival-index word — ties break by
+    post-filter row order, which is chunk-geometry-invariant — and merged
+    into a capacity-k device buffer: concat buffer-first, one lexsort, one
+    gather.  The buffer is the answer, already sorted; memory stays
+    O(k + chunk) however large the table.  Otherwise: full sort + slice.
+    """
+    from ..ops.order import SortKey
+    from ..ops.selection import slice_table, sort_table
+    from ..utils.config import config
+
+    scan = _single_chunked_scan(node.child) if config.topk else None
+    if scan is None or node.n == 0:
+        t = _exec(node.child, memo, stats, ctx)
+        t = sort_table(t, [SortKey(t[c], ascending=a)
+                           for c, a in node.keys])
+        return slice_table(t, 0, min(node.n, t.num_rows))
+
+    from ..io import ParquetChunkedReader
+    from ..ops.order import encode_keys
+    from ..ops.selection import concat_tables, gather_table
+
+    _precompute_independent(node.child, scan, memo, stats, ctx)
+
+    cols = list(scan.columns) if scan.columns else None
+    reader = ParquetChunkedReader(
+        scan.path, pass_read_limit=scan.chunk_bytes,
+        columns=cols, predicate=scan.predicate, prefetch=ctx.prefetch)
+    stats["streamed"] = True
+    stats["topk"] = True
+    stats["pipelined"] = ctx.prefetch > 0
+
+    buf: Optional[Table] = None   # current top rows (<= k), sorted
+    buf_words: list = []          # their u64 sort words (incl. tiebreak)
+    rows_seen = 0
+    try:
+        for chunk in reader:
+            stats["chunks"] += 1
+            sub = _ChunkMemo(memo)
+            sub[id(scan)] = chunk
+            t = _exec(node.child, sub, stats, ctx)
+            n = t.num_rows
+            if n == 0:
+                continue
+            words = encode_keys([SortKey(t[c], ascending=a)
+                                 for c, a in node.keys])
+            words.append(jnp.arange(n, dtype=jnp.uint64)
+                         + jnp.uint64(rows_seen))
+            rows_seen += n
+            if buf is None:
+                cand_t, cand_w = t, words
+            else:
+                cand_t = concat_tables([buf, t])
+                cand_w = [jnp.concatenate([bw, w])
+                          for bw, w in zip(buf_words, words)]
+            order = jnp.lexsort(tuple(reversed(cand_w)))
+            keep = order[:min(node.n, order.shape[0])]
+            buf = gather_table(cand_t, keep)
+            buf_words = [w[keep] for w in cand_w]
+    finally:
+        reader.close()
+    stats["row_groups_pruned"] += reader.groups_pruned
+    stats["row_groups_read"] += reader.groups_read
+
+    if buf is None:
+        # nothing survived: one empty-chunk walk for the output schema
+        from ..io import ParquetFile
+        sub = _ChunkMemo(memo)
+        sub[id(scan)] = ParquetFile(scan.path).empty_table(cols)
+        return _exec(node.child, sub, stats, ctx)
+    return buf
 
 
 def execute(plan: PlanNode, stats: Optional[dict] = None,

@@ -1,6 +1,9 @@
 """Rule-based logical plan rewrites.
 
-Three rules, applied in a fixed order chosen so each enables the next:
+A structural rule first: ``Limit(Sort(x), n)`` fuses into ``TopK`` so the
+executor can stream ORDER BY ... LIMIT as a per-chunk partial top-k instead
+of materializing the full sorted table.  Then three rules, applied in a
+fixed order chosen so each enables the next:
 
 1. **Filter split + pushdown below joins** — conjunctions split into single
    filters; a filter whose columns all come from one join input moves below
@@ -24,7 +27,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, expr_columns, rebuild)
+                   Sort, TopK, expr_columns, rebuild)
 
 #: comparisons a scan predicate hint can absorb (col vs literal)
 _RANGE_OPS = {">=", "<=", ">", "<", "=="}
@@ -61,7 +64,7 @@ def output_names(node: PlanNode, schema: Optional[_Schema] = None,
         out = schema.scan_names(node)
     elif isinstance(node, Project):
         out = list(node.columns)
-    elif isinstance(node, (Filter, Sort, Limit)):
+    elif isinstance(node, (Filter, Sort, Limit, TopK)):
         out = output_names(node.child, schema, memo)
     elif isinstance(node, Aggregate):
         out = list(node.keys) + list(node.names)
@@ -154,6 +157,26 @@ def _rename_expr(expr, mapping):
     if expr[0] == "lit":
         return expr
     return (expr[0],) + tuple(_rename_expr(e, mapping) for e in expr[1:])
+
+
+# -- rule 0: ORDER BY ... LIMIT -> TopK ------------------------------------
+
+def _fuse_topk(node: PlanNode, memo: dict) -> PlanNode:
+    """``Limit(Sort(x), n)`` becomes ``TopK(x, keys, n)`` — semantically
+    identical (sort-then-slice), but the fused node tells the executor the
+    full sorted table is never observed, so a streaming partial top-k
+    (capacity-n device buffer, merged once) is a legal physical plan."""
+    if id(node) in memo:
+        return memo[id(node)]
+    kids = {f: _fuse_topk(getattr(node, f), memo)
+            for f in ("child", "left", "right") if hasattr(node, f)}
+    out = rebuild(node, **{k: v for k, v in kids.items()
+                           if v is not getattr(node, k)})
+    if isinstance(out, Limit) and isinstance(out.child, Sort):
+        srt = out.child
+        out = TopK(srt.child, srt.keys, out.n)
+    memo[id(node)] = out
+    return out
 
 
 # -- rule 2: predicate pushdown into scan row-group pruning ----------------
@@ -270,7 +293,7 @@ def _collect_required(node: PlanNode, needed, schema: _Schema, req: dict):
     elif isinstance(node, Filter):
         sub = None if needed is None else needed | expr_columns(node.predicate)
         _collect_required(node.child, sub, schema, req)
-    elif isinstance(node, Sort):
+    elif isinstance(node, (Sort, TopK)):
         sub = None if needed is None else needed | {c for c, _ in node.keys}
         _collect_required(node.child, sub, schema, req)
     elif isinstance(node, Limit):
@@ -324,6 +347,7 @@ def _apply_pruning(node: PlanNode, schema: _Schema, req: dict,
 def optimize(plan: PlanNode) -> PlanNode:
     """Apply all rewrite rules; returns a new plan (input untouched)."""
     schema = _Schema()
+    plan = _fuse_topk(plan, {})
     plan = _push_filters(plan, schema, {})
     plan = _push_scan_predicates(plan, {})
     req: dict = {}

@@ -339,8 +339,37 @@ class Limit(PlanNode):
         return cls(child=built[d["child"]], n=d["n"])
 
 
+@dataclass(frozen=True, eq=False)
+class TopK(PlanNode):
+    """First ``n`` rows of the child under ``keys`` ordering — the fused
+    ORDER BY ... LIMIT form the optimizer rewrites ``Limit(Sort(x), n)``
+    into.  Semantically identical to sort-then-slice; the executor may run
+    it as a streaming per-chunk partial top-k (a capacity-``n`` device
+    buffer instead of a full materialized sort) when ``SRJT_TOPK`` is on.
+    ``keys`` = ((column, ascending), ...), like ``Sort``."""
+    child: PlanNode
+    keys: Tuple[tuple, ...]
+    n: int
+
+    def __post_init__(self):
+        object.__setattr__(self, "keys",
+                           tuple((str(c), bool(a)) for c, a in self.keys))
+        if int(self.n) < 0:
+            raise ValueError("topk n must be >= 0")
+        object.__setattr__(self, "n", int(self.n))
+
+    def _node_dict(self, child_ids):
+        return {"child": child_ids[0],
+                "keys": [list(k) for k in self.keys], "n": self.n}
+
+    @classmethod
+    def _from_dict(cls, d, built):
+        return cls(child=built[d["child"]],
+                   keys=tuple(tuple(k) for k in d["keys"]), n=d["n"])
+
+
 _NODE_TYPES = {c.__name__: c for c in
-               (Scan, Filter, Project, Join, Aggregate, Sort, Limit)}
+               (Scan, Filter, Project, Join, Aggregate, Sort, Limit, TopK)}
 
 
 def from_dict(obj: dict) -> PlanNode:

@@ -17,6 +17,12 @@ plans.  The TPU translation:
   root feeds the mask straight into ``groupby_padded(row_mask=...)``.
   Intermediates therefore never materialize: one fused program, one
   dispatch, at most one host sync at the segment boundary.
+- On the streamed path a Join whose build side is scan-independent is NOT
+  a breaker (``build_stream_segment``): the prepared build (hash + stable
+  sort, cached in ``engine.cache.BUILD_CACHE``) enters the program as a
+  pytree input and each probe chunk masks/gathers at probe-row shape —
+  filter -> project -> probe-join -> partial-agg runs as one traced
+  callable per chunk with zero per-chunk host syncs.
 - Compiled segments live in a process-wide LRU keyed by
   ``(segment fingerprint, input shape-class)`` with hit/miss/eviction
   counters in ``utils.tracing`` (``engine.segment_cache.*``).  The
@@ -39,10 +45,15 @@ import numpy as np
 from ..columnar import Column, Table
 from ..utils import tracing
 from ..utils.config import config
-from .plan import Aggregate, Filter, PlanNode, Project, expr_columns, topo_nodes
+from .plan import (Aggregate, Filter, Join, PlanNode, Project, expr_columns,
+                   topo_nodes)
 
 #: chain members fusable into a segment body (everything else is a breaker)
 _FUSABLE = (Filter, Project)
+
+#: join types the streamed probe-join program supports (output stays at
+#: probe-row shape: semi masks, inner gathers one build row per probe row)
+_FUSABLE_JOINS = ("inner", "semi")
 
 
 # -- segment extraction ----------------------------------------------------
@@ -63,13 +74,17 @@ def _agg_fusable(agg: Aggregate) -> bool:
 
 
 class Segment:
-    """One fusable chain: ``input -> chain (bottom-up) [-> agg]``."""
+    """One fusable chain: ``input -> chain (bottom-up) [-> agg]``.
+
+    On the streamed path the chain may contain ``Join`` nodes whose build
+    side is scan-independent (``build_stream_segment``); their prepared
+    builds enter the jitted program as extra pytree inputs."""
 
     __slots__ = ("chain", "agg", "input", "_fp")
 
     def __init__(self, chain: tuple, agg: Optional[Aggregate],
                  input_node: PlanNode):
-        self.chain = chain          # Filter/Project nodes, execution order
+        self.chain = chain          # Filter/Project/Join nodes, exec order
         self.agg = agg              # optional Aggregate root
         self.input = input_node     # breaker output the segment consumes
         self._fp: Optional[str] = None
@@ -77,14 +92,23 @@ class Segment:
     def nodes(self) -> tuple:
         return self.chain + ((self.agg,) if self.agg is not None else ())
 
+    def joins(self) -> tuple:
+        """Join nodes in the chain, execution order."""
+        return tuple(nd for nd in self.chain if isinstance(nd, Join))
+
     def fingerprint(self) -> str:
         """Structure-only identity (the plan-cache analog, input excluded):
         equal chains over different inputs share compiled executables."""
         if self._fp is None:
             sig = []
             for nd in self.chain:
-                sig.append(("filter", nd.predicate) if isinstance(nd, Filter)
-                           else ("project", tuple(nd.columns)))
+                if isinstance(nd, Filter):
+                    sig.append(("filter", nd.predicate))
+                elif isinstance(nd, Join):
+                    sig.append(("join", tuple(nd.left_keys),
+                                tuple(nd.right_keys), nd.how))
+                else:
+                    sig.append(("project", tuple(nd.columns)))
             if self.agg is not None:
                 sig.append(("aggregate", tuple(self.agg.keys),
                             tuple(self.agg.aggs), tuple(self.agg.names)))
@@ -126,6 +150,37 @@ def build_segment(top: PlanNode, nparents: dict) -> Optional[Segment]:
     return Segment(tuple(reversed(chain)), agg, cur)
 
 
+def build_stream_segment(agg: Aggregate, scan: PlanNode,
+                         nparents: dict,
+                         fuse_join: bool = True) -> Optional[Segment]:
+    """The streamed-path segment under ``agg``: like ``build_segment``, but
+    an inner/semi Join whose build (right) side is scan-independent is
+    absorbed instead of breaking — the chain continues down the probe
+    (left) side toward the scan, and the prepared build becomes a pytree
+    input of the jitted chunk program.
+    """
+    if not _agg_fusable(agg):
+        return None
+    from .executor import _depends_on
+    dep: dict = {}
+    chain = []
+    cur = agg.child
+    while True:
+        if isinstance(cur, _FUSABLE) and nparents.get(id(cur), 1) == 1:
+            chain.append(cur)
+            cur = cur.child
+        elif (fuse_join and isinstance(cur, Join)
+              and nparents.get(id(cur), 1) == 1
+              and cur.how in _FUSABLE_JOINS
+              and _depends_on(cur.left, scan, dep)
+              and not _depends_on(cur.right, scan, dep)):
+            chain.append(cur)
+            cur = cur.left
+        else:
+            break
+    return Segment(tuple(reversed(chain)), agg, cur)
+
+
 def worthwhile(seg: Segment, streaming: bool = False) -> bool:
     """Fusion must beat the interpreter to be worth a compile: a lone
     Project is a metadata select and a bare Aggregate already runs as one
@@ -154,6 +209,85 @@ def runtime_eligible(seg: Segment, table: Table) -> bool:
     return True
 
 
+def _needed_after(seg: Segment, pos: int) -> frozenset:
+    """Column names referenced by chain nodes at index >= ``pos`` plus the
+    agg root — the set an inner join in the chain must materialize from
+    the build side (everything else on the right is dead weight)."""
+    need = set()
+    for nd in seg.chain[pos:]:
+        if isinstance(nd, Filter):
+            need |= expr_columns(nd.predicate)
+        elif isinstance(nd, Join):
+            need |= set(nd.left_keys)
+        else:
+            need |= set(nd.columns)
+    if seg.agg is not None:
+        need |= set(seg.agg.keys)
+        need |= {c for c, _ in seg.agg.aggs if c is not None}
+    return frozenset(need)
+
+
+def _join_out_name(name: str, left_names) -> str:
+    """Inner-join output name for a right payload column (the executor's
+    ``_r``-suffix collision rule)."""
+    return name + "_r" if name in left_names else name
+
+
+def stream_runtime_eligible(seg: Segment, table: Table,
+                            builds: tuple) -> bool:
+    """``runtime_eligible`` for join-bearing stream segments: walks the
+    chain tracking the available name -> Column mapping (chunk columns,
+    then gathered build payloads), vetoing strings / non-1-D buffers in
+    any computed-on or gathered position."""
+    if not seg.joins():
+        return runtime_eligible(seg, table)
+    if seg.agg is not None and table.num_rows == 0:
+        return False
+
+    def ok(c: Column) -> bool:
+        return not (c.dtype.is_string or c.data is None or c.data.ndim != 1)
+
+    try:
+        avail = {nm: table.column(nm) for nm in (table.names or [])}
+        ji = 0
+        for i, nd in enumerate(seg.chain):
+            if isinstance(nd, Filter):
+                for name in expr_columns(nd.predicate):
+                    if not ok(avail[name]):
+                        return False
+            elif isinstance(nd, Project):
+                avail = {nm: avail[nm] for nm in nd.columns}
+            else:  # Join
+                b = builds[ji]
+                ji += 1
+                for k in nd.left_keys:
+                    if not ok(avail[k]):
+                        return False
+                bcols = {nm: b.column(nm) for nm in (b.names or [])}
+                for k in nd.right_keys:
+                    if not ok(bcols[k]):
+                        return False
+                if nd.how == "inner":
+                    lnames = set(avail)
+                    needed = _needed_after(seg, i + 1)
+                    for nm in (b.names or []):
+                        if nm in nd.right_keys:
+                            continue
+                        out_nm = _join_out_name(nm, lnames)
+                        if out_nm in needed:
+                            if not ok(bcols[nm]):
+                                return False
+                            avail[out_nm] = bcols[nm]
+        if seg.agg is not None:
+            for name in set(seg.agg.keys) | \
+                    {c for c, _ in seg.agg.aggs if c is not None}:
+                if not ok(avail[name]):
+                    return False
+        return True
+    except (KeyError, ValueError):
+        return False
+
+
 # -- compiled form ----------------------------------------------------------
 
 def shape_class(table: Table) -> tuple:
@@ -171,27 +305,65 @@ def shape_class(table: Table) -> tuple:
     )
 
 
+def _probe_join_node(nd: Join, pb, table: Table, live, needed):
+    """One fused probe-join step at probe-row shape: mask ``live`` by the
+    verified match, and (inner only) gather the needed build payload
+    columns at the matched build rows.  No expansion, no host sync — the
+    prepared build guarantees <= 1 candidate per probe row."""
+    from ..ops.join import probe_join_prepared
+    from ..ops.selection import gather_column
+    lk = Table([table.column(k) for k in nd.left_keys])
+    ri, matched = probe_join_prepared(lk, pb, left_live=live)
+    live = live & matched
+    if nd.how == "semi":
+        return table, live
+    lnames = list(table.names or [])
+    cols, names = list(table.columns), list(lnames)
+    n = table.num_rows
+    for nm, c in zip(pb.payload.names or [], pb.payload.columns):
+        if nm in nd.right_keys:
+            continue
+        out_nm = _join_out_name(nm, lnames)
+        if out_nm not in needed:
+            continue
+        if pb.nr == 0:  # dead rows only (live is all-False); typed zeros
+            cols.append(Column(c.dtype, data=jnp.zeros((n,), c.data.dtype)))
+        else:
+            cols.append(gather_column(c, ri))
+        names.append(out_nm)
+    return Table(cols, names), live
+
+
 def _build_fn(seg: Segment, compiled: "CompiledSegment"):
     """The single program a segment traces into.
 
-    ``fn(table, nvalid)``: rows >= nvalid are padding (chunk buckets).
-    Map segments return (table, live); agg segments return padded partial
-    aggregates + group-live mask — all device-resident, zero host syncs.
+    ``fn(table, nvalid, prepared)``: rows >= nvalid are padding (chunk
+    buckets); ``prepared`` carries one ``PreparedBuild`` pytree per Join
+    in the chain (execution order).  Map segments return (table, live);
+    agg segments return padded partial aggregates + group-live mask — all
+    device-resident, zero host syncs.
     """
     chain, agg = seg.chain, seg.agg
+    needed = {i: _needed_after(seg, i + 1)
+              for i, nd in enumerate(chain) if isinstance(nd, Join)}
 
-    def fn(table: Table, nvalid):
+    def fn(table: Table, nvalid, prepared=()):
         from ..ops.aggregate import groupby_padded
         from .executor import _eval_expr
         compiled.traces += 1  # trace-time side effect: the no-recompile proof
         live = jnp.arange(table.num_rows, dtype=jnp.int32) < nvalid
-        for nd in chain:
+        ji = 0
+        for i, nd in enumerate(chain):
             if isinstance(nd, Filter):
                 vals, valid = _eval_expr(nd.predicate, table)
                 m = jnp.asarray(vals, jnp.bool_)
                 if valid is not None:
                     m = m & valid  # SQL semantics: NULL comparison drops
                 live = live & m
+            elif isinstance(nd, Join):
+                table, live = _probe_join_node(nd, prepared[ji], table,
+                                               live, needed[i])
+                ji += 1
             else:
                 table = table.select(list(nd.columns))
         if agg is None:
@@ -224,10 +396,27 @@ class CompiledSegment:
         self.calls = 0
         self.jfn = jax.jit(_build_fn(segment, self))
 
-    def __call__(self, table: Table, nvalid=None):
+    def __call__(self, table: Table, nvalid=None, prepared=()):
         self.calls += 1
         nv = jnp.int32(table.num_rows if nvalid is None else nvalid)
-        return self.jfn(table, nv)
+        return self.jfn(table, nv, tuple(prepared))
+
+
+def _resolve_dtype(name: str, table: Table, builds: tuple):
+    """Dtype of an agg key that may come off a join's build side (raw name
+    or with the ``_r`` collision suffix stripped)."""
+    try:
+        return table.column(name).dtype
+    except (KeyError, ValueError):
+        pass
+    base = name[:-2] if name.endswith("_r") else name
+    for b in builds:
+        for cand in (name, base):
+            try:
+                return b.column(cand).dtype
+            except (KeyError, ValueError):
+                continue
+    raise KeyError(name)
 
 
 class SegmentCache:
@@ -254,8 +443,10 @@ class SegmentCache:
         return self._maxsize if self._maxsize is not None \
             else config.segment_cache
 
-    def get(self, segment: Segment, table: Table) -> CompiledSegment:
-        key = (segment.fingerprint(), shape_class(table))
+    def get(self, segment: Segment, table: Table,
+            builds: tuple = ()) -> CompiledSegment:
+        key = (segment.fingerprint(), shape_class(table),
+               tuple(shape_class(b) for b in builds))
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None:
@@ -264,7 +455,7 @@ class SegmentCache:
                 tracing.count("engine.segment_cache.hit")
                 return hit
         key_dtypes = () if segment.agg is None else tuple(
-            table.column(k).dtype for k in segment.agg.keys)
+            _resolve_dtype(k, table, builds) for k in segment.agg.keys)
         compiled = CompiledSegment(key, segment, key_dtypes)
         with self._lock:
             racer = self._entries.get(key)

@@ -1086,8 +1086,29 @@ class ParquetChunkedReader:
         # its execution stats to prove predicate pushdown engaged
         self.groups_pruned = 0
         self.groups_read = 0
+        # live prefetch generators: a consumer loop that raises mid-stream
+        # never closes its iterator, which would leave the producer thread
+        # parked on the bounded queue until GC; ``close()`` reaps them
+        self._active: list = []
         if self.limit <= 0:
             raise ValueError("pass_read_limit must be positive")
+
+    def close(self) -> None:
+        """Stop any live prefetch producer threads (idempotent).
+
+        Closing the tracked generator raises GeneratorExit at its yield
+        point, running ``_prefetched``'s finally: stop event, queue drain,
+        thread join.  Streamed executions call this in a finally; ``with
+        ParquetChunkedReader(...) as r`` does it automatically."""
+        while self._active:
+            self._active.pop().close()
+
+    def __enter__(self) -> "ParquetChunkedReader":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     def _group_pruned(self, gi: int) -> bool:
         if self.predicate is None:
@@ -1171,13 +1192,24 @@ class ParquetChunkedReader:
         if depth <= 0:
             yield from gen
         else:
-            yield from _prefetched(gen, depth)
+            yield from self._tracked(_prefetched(gen, depth))
 
     def __iter__(self):
         if self.prefetch <= 0:
             yield from self._chunks()
             return
-        yield from _prefetched(self._chunks(), self.prefetch)
+        yield from self._tracked(_prefetched(self._chunks(), self.prefetch))
+
+    def _tracked(self, pf):
+        """Register a prefetch generator for ``close()`` while it runs."""
+        self._active.append(pf)
+        try:
+            yield from pf
+        finally:
+            try:
+                self._active.remove(pf)
+            except ValueError:
+                pass  # close() already reaped it
 
 
 def _prefetched(gen, depth: int):

@@ -32,6 +32,7 @@ from . import datetime  # noqa: F401
 from .join import (  # noqa: F401
     inner_join, left_join, right_join, full_join, cross_join,
     left_semi_join, left_anti_join, sort_merge_join,
+    PreparedBuild, prepare_build, probe_join_prepared,
 )
 from .binary import (  # noqa: F401
     add, subtract, multiply, true_divide, floor_div, modulo,

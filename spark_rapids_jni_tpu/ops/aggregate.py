@@ -583,8 +583,10 @@ def groupby_padded(table: Table, key_names: list, aggs: list[tuple],
 
     live_sorted = None if row_mask is None else jnp.take(row_mask, order)
     out_aggs = []
-    for col_ref, op in aggs:
-        col = table.column(col_ref) if not isinstance(col_ref, Column) else col_ref
+    for col, op in resolved:
+        if col is None:  # count_all carries no input column
+            out_aggs.append(_agg_column(None, op, order, seg, n, live_sorted))
+            continue
         if col.dtype.is_string and op not in ("count", "count_all"):
             raise TypeError("string value aggregation not supported")
         if col.dtype.is_string and op == "count":

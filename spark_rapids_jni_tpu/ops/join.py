@@ -119,6 +119,17 @@ def _rank_bounds(ref, queries, ref_sorted=None) \
     return lo, hi
 
 
+def _build_sort(rh):
+    """The build-side half of the sorted-probe prelude: cast to the 32-bit
+    rank domain and stable-sort once.  Factored out so ``PreparedBuild``
+    can compute it once per execution and share it across probe chunks."""
+    rh = rh.astype(_I32)
+    rh_sorted, r_order = jax.lax.sort(
+        (rh, jnp.arange(rh.shape[0], dtype=_I32)), num_keys=1,
+        is_stable=True)
+    return rh, rh_sorted, r_order
+
+
 def _probe_ranges(lh, rh):
     """Sorted-probe prelude: one sort of the build side, per-probe ranges.
 
@@ -132,10 +143,7 @@ def _probe_ranges(lh, rh):
     verification downstream filters it, same as a full hash collision.
     """
     lh = lh.astype(_I32)
-    rh = rh.astype(_I32)
-    rh_sorted, r_order = jax.lax.sort(
-        (rh, jnp.arange(rh.shape[0], dtype=_I32)), num_keys=1,
-        is_stable=True)
+    rh, rh_sorted, r_order = _build_sort(rh)
     lo, hi = _rank_bounds(rh, lh, ref_sorted=rh_sorted)
     lo, hi = lo.astype(_I32), hi.astype(_I32)
     counts = (hi - lo).astype(jnp.int64)
@@ -143,6 +151,106 @@ def _probe_ranges(lh, rh):
     starts = offsets - counts
     expansion = offsets[-1] if counts.shape[0] else jnp.int64(0)
     return r_order, lo, offsets, starts, expansion
+
+
+@jax.tree_util.register_pytree_node_class
+class PreparedBuild:
+    """Join build-side state reusable across probe chunks.
+
+    Captures everything ``_probe_ranges`` derives from the build side —
+    xxhash64 of the key columns (dead rows replaced by even sentinels), the
+    32-bit rank-domain cast, and the stable build sort (``rh_sorted`` /
+    ``r_order``) — plus the key and payload Tables the per-pair verify and
+    output assembly gather from.  Computed ONCE per join per execution
+    (cached in ``engine.cache.BUILD_CACHE`` across chunks/executions) where
+    the naive streamed loop re-hashed and re-sorted the build side on every
+    chunk.
+
+    ``unique`` (host bool, the one sync ``prepare_build`` pays) says the
+    sorted 32-bit hashes are duplicate-free: every probe row then has at
+    most one candidate, which is what lets ``probe_join_prepared`` stay at
+    probe-row shape with no expansion and no per-chunk sync.  Registered as
+    a jax pytree so a prepared build crosses the jit boundary of a fused
+    chunk program as ordinary traced inputs.
+    """
+
+    __slots__ = ("rk", "payload", "rh", "rh_sorted", "r_order",
+                 "right_live", "unique", "nr")
+
+    def __init__(self, rk, payload, rh, rh_sorted, r_order, right_live,
+                 unique, nr):
+        self.rk = rk                  # build key Table
+        self.payload = payload        # build Table for output gathers
+        self.rh = rh                  # int32 sentinel-adjusted hashes
+        self.rh_sorted = rh_sorted
+        self.r_order = r_order
+        self.right_live = right_live  # optional build row mask
+        self.unique = unique          # host bool: sorted hashes distinct
+        self.nr = nr
+
+    def tree_flatten(self):
+        return ((self.rk, self.payload, self.rh, self.rh_sorted,
+                 self.r_order, self.right_live), (self.unique, self.nr))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def prepare_build(right: Table, on_right, right_live=None,
+                  payload: Table | None = None) -> PreparedBuild:
+    """Hash + sort the join build side once; see ``PreparedBuild``.
+
+    ``payload`` defaults to ``right`` itself (inner-join output columns);
+    pass a pruned Table to bound what fused programs carry.  One host sync
+    (the ``unique`` scalar) per call — never per probe chunk.
+    """
+    rk = _key_table(right, on_right)
+    rh = xxhash64(rk).data
+    if right_live is not None:
+        iota = jnp.arange(rh.shape[0], dtype=rh.dtype)
+        rh = jnp.where(right_live, rh, iota * 2)  # even sentinels
+    rh32, rh_sorted, r_order = _build_sort(rh)
+    nr = int(rh32.shape[0])
+    unique = True if nr <= 1 else \
+        bool(jnp.all(rh_sorted[1:] != rh_sorted[:-1]))
+    return PreparedBuild(rk, right if payload is None else payload,
+                         rh32, rh_sorted, r_order, right_live, unique, nr)
+
+
+def probe_join_prepared(left_keys: Table, pb: PreparedBuild,
+                        left_live=None, null_equal: bool = False):
+    """Probe a ``PreparedBuild``: masked gather map + match mask per row.
+
+    Requires ``pb.unique`` (every build hash appears at most once in the
+    32-bit rank domain), so each probe row has at most ONE candidate and
+    the result stays at probe-row shape — no expansion sort, fully
+    jit-able, zero host syncs.  Returns ``(ri, matched)``: the int32 build
+    row per probe row (arbitrary where unmatched — mask before trusting
+    it) and the bool match mask.  ``null_equal=True`` is null-safe
+    equality (``<=>``); default SQL semantics never match null keys.
+    """
+    lh = xxhash64(left_keys).data
+    nl = lh.shape[0]
+    if left_live is not None:
+        iota = jnp.arange(nl, dtype=lh.dtype)
+        lh = jnp.where(left_live, lh, iota * 2 + 1)  # odd sentinels
+    lh = lh.astype(_I32)
+    if pb.nr == 0:
+        return jnp.zeros((nl,), _I32), jnp.zeros((nl,), jnp.bool_)
+    lo, hi = _rank_bounds(pb.rh, lh, ref_sorted=pb.rh_sorted)
+    matched = hi > lo
+    ri = jnp.take(pb.r_order,
+                  jnp.clip(lo, 0, pb.nr - 1).astype(_I32)).astype(_I32)
+    li = jnp.arange(nl, dtype=_I32)
+    eq = matched
+    for lc, rc in zip(left_keys.columns, pb.rk.columns):
+        eq = eq & _pair_equal(lc, rc, li, ri, null_equal=null_equal)
+    if pb.right_live is not None:
+        eq = eq & jnp.take(pb.right_live, ri)
+    if left_live is not None:
+        eq = eq & left_live
+    return ri, eq
 
 
 def _expand_pairs(r_order, lo, offsets, starts, nl, nr, total):

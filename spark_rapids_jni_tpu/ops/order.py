@@ -164,6 +164,8 @@ def rows_differ_from_prev(words: list[jnp.ndarray],
     GROUP BY null semantics.
     """
     n = order.shape[0]
+    if n == 0:  # no rows, no boundaries (``.at[0]`` would be OOB)
+        return jnp.zeros((0,), jnp.bool_)
     first = jnp.zeros((n,), jnp.bool_).at[0].set(True)
     diff = first
     for wd in words:

@@ -15,6 +15,9 @@ environment flags read once at import:
 | ``SRJT_PREFETCH``     | ``1``   | chunked-scan pipeline depth (0 = serial) |
 | ``SRJT_PLAN_CACHE``   | ``128`` | plan-cache capacity (spark.sql plan-cache size) |
 | ``SRJT_SEGMENT_CACHE``| ``256`` | compiled-segment cache capacity |
+| ``SRJT_FUSE_JOIN``    | ``1``   | fuse scan-independent-build joins into streamed chunk programs |
+| ``SRJT_TOPK``         | ``1``   | streaming top-k for ORDER BY ... LIMIT (TopK plans) |
+| ``SRJT_BUILD_CACHE``  | ``32``  | prepared-join-build cache capacity (entries) |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -54,6 +57,9 @@ class Config:
     prefetch: int = 1            # chunked-scan pipeline depth (0 = serial)
     plan_cache: int = 128        # PlanCache capacity (entries)
     segment_cache: int = 256     # compiled-segment cache capacity (entries)
+    fuse_join: bool = True       # probe-join fusion on the streamed path
+    topk: bool = True            # streaming top-k execution of TopK plans
+    build_cache: int = 32        # prepared-build cache capacity (entries)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -66,6 +72,9 @@ class Config:
             prefetch=_int_flag("SRJT_PREFETCH", 1),
             plan_cache=_int_flag("SRJT_PLAN_CACHE", 128, minimum=1),
             segment_cache=_int_flag("SRJT_SEGMENT_CACHE", 256, minimum=1),
+            fuse_join=_bool_flag("SRJT_FUSE_JOIN", True),
+            topk=_bool_flag("SRJT_TOPK", True),
+            build_cache=_int_flag("SRJT_BUILD_CACHE", 32, minimum=1),
         )
 
 
@@ -84,6 +93,9 @@ def refresh() -> Config:
     config.prefetch = new.prefetch
     config.plan_cache = new.plan_cache
     config.segment_cache = new.segment_cache
+    config.fuse_join = new.fuse_join
+    config.topk = new.topk
+    config.build_cache = new.build_cache
     logger().setLevel(config.log_level)
     return config
 
