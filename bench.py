@@ -946,7 +946,17 @@ def smoke():
                       "ok": jok,
                       "chunks": jres["chunks"] if jres else None,
                       "build_cache": jres["build_cache"] if jres else None}))
-    return 0 if (ok and jok) else 1
+    # third line: the observability layer itself — every execute() above ran
+    # under a QueryMetrics, so with SRJT_METRICS on the snapshot must carry
+    # per-query summaries (premerge greps this line for the block)
+    from spark_rapids_jni_tpu.utils import metrics
+    snap = metrics.snapshot()
+    mok = (not metrics.enabled()) or bool(snap["queries"])
+    print(json.dumps({"metric": "metrics_snapshot",
+                      "ok": mok,
+                      "enabled": metrics.enabled(),
+                      **snap}))
+    return 0 if (ok and jok and mok) else 1
 
 
 def main():
@@ -1114,8 +1124,20 @@ def main():
                         "materialize + full sort + slice on the same "
                         "optimized plan (>1 means streaming wins)"}}
                if ejoin else {}),
+            "metrics_snapshot": _metrics_snapshot(),
         },
     }))
+
+
+def _metrics_snapshot() -> dict:
+    """The SRJT_METRICS layer's view of everything the bench just ran:
+    flat counters, histograms/gauges, and the most recent per-query
+    summaries (bounded — the full deque holds 32)."""
+    from spark_rapids_jni_tpu.utils import metrics
+    snap = metrics.snapshot()
+    snap["enabled"] = metrics.enabled()
+    snap["queries"] = metrics.recent_summaries(limit=8)
+    return snap
 
 
 if __name__ == "__main__":

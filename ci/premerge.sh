@@ -23,8 +23,22 @@ python -m pytest tests/ -q
 # engine perf-path smoke: tiny shapes through the fused-segment and
 # double-buffered streaming paths end-to-end (correctness cross-checks,
 # no timing assertions) — keeps the bench's perf paths runnable without
-# paying full bench time in the gate
-JAX_PLATFORMS=cpu python bench.py --smoke
+# paying full bench time in the gate.  Runs with tracing AND the metrics
+# layer forced on so the instrumented paths (spans, histograms, Perfetto
+# annotations) are exercised in-gate; the snapshot line must carry the
+# per-query summary block (docs/OBSERVABILITY.md).
+SMOKE_OUT=$(JAX_PLATFORMS=cpu SRJT_TRACE=1 SRJT_METRICS=1 \
+    python bench.py --smoke)
+echo "$SMOKE_OUT"
+echo "$SMOKE_OUT" | python -c '
+import json, sys
+snaps = [json.loads(l) for l in sys.stdin if l.strip()]
+snap = [s for s in snaps if s.get("metric") == "metrics_snapshot"]
+assert snap, "bench.py --smoke emitted no metrics_snapshot line"
+assert snap[0].get("queries"), "metrics snapshot missing per-query summaries"
+assert snap[0]["ok"], "metrics snapshot not ok"
+print("metrics snapshot: %d per-query summaries" % len(snap[0]["queries"]))
+'
 
 # the driver's multi-chip entry must keep compiling + executing
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

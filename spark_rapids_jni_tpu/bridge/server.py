@@ -138,6 +138,7 @@ class BridgeServer:
         # lazily built on the first PLAN_EXECUTE (imports the engine)
         self._plan_cache = None
         self._last_plan_stats: dict = {}
+        self._last_plan_summary: dict = {}
         from ..utils.config import logger
         self._log = logger()
 
@@ -409,10 +410,18 @@ class BridgeServer:
         if self._plan_cache is None:
             from ..engine import PlanCache
             self._plan_cache = PlanCache()
-        compiled = self._plan_cache.get(plan)
+        from ..utils import metrics
         stats: dict = {}
-        out = compiled.execute(stats=stats)
+        # plan-cache lookup runs inside the query context so its hit/miss
+        # is attributed to the query that caused it (OP_METRICS `queries`)
+        with metrics.query(f"plan:{plan.fingerprint()[:12]}") as qm:
+            compiled = self._plan_cache.get(plan)
+            out = compiled.execute(stats=stats)
+            if qm is not None:
+                qm.note_stats(stats)
         self._last_plan_stats = stats
+        if qm is not None:
+            self._last_plan_summary = qm.summary()
         h = self.handles.put(out)
         return struct.pack("<I", 1) + struct.pack("<Q", h)
 
@@ -476,6 +485,16 @@ class BridgeServer:
         if self._plan_cache is not None:
             snap["plan_cache"] = self._plan_cache.stats()
             snap["last_plan"] = dict(self._last_plan_stats)
+            if self._last_plan_summary:
+                snap["last_plan_summary"] = dict(self._last_plan_summary)
+        # engine-wide observability: the flat monotonic counters plus the
+        # SRJT_METRICS layer (histograms as [le, count] pairs, gauges, and
+        # recent per-query summaries) — all JSON-native by construction
+        from ..utils import metrics, tracing
+        snap["counters"] = tracing.counters_snapshot()
+        snap["histograms"] = metrics.histograms_snapshot()
+        snap["gauges"] = metrics.gauges_snapshot()
+        snap["queries"] = metrics.recent_summaries()
         return json.dumps(snap).encode()
 
     def serve_forever(self) -> None:

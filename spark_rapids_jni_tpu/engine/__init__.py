@@ -43,6 +43,7 @@ from .cache import (  # noqa: F401
     CompiledPlan,
     PlanCache,
 )
+from .explain import ExplainReport, explain_analyze  # noqa: F401
 from .segment import (  # noqa: F401
     SEGMENT_CACHE,
     CompiledSegment,

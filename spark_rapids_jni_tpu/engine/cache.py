@@ -27,7 +27,7 @@ import threading
 from collections import OrderedDict
 from typing import Optional
 
-from ..utils import tracing
+from ..utils import metrics
 from .executor import execute
 from .optimizer import optimize
 from .plan import PlanNode
@@ -80,7 +80,7 @@ class PlanCache:
             if hit is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                tracing.count("engine.plan_cache.hit")
+                metrics.count("engine.plan_cache.hit")
                 return hit
         # optimize outside the lock (reads file footers for schemas)
         compiled = CompiledPlan(key, plan, optimize(plan))
@@ -89,15 +89,15 @@ class PlanCache:
             if racer is not None:  # lost a concurrent-miss race: their entry
                 self._entries.move_to_end(key)
                 self.hits += 1
-                tracing.count("engine.plan_cache.hit")
+                metrics.count("engine.plan_cache.hit")
                 return racer
             self.misses += 1
-            tracing.count("engine.plan_cache.miss")
+            metrics.count("engine.plan_cache.miss")
             self._entries[key] = compiled
             while len(self._entries) > self.maxsize:
                 self._entries.popitem(last=False)
                 self.evictions += 1
-                tracing.count("engine.plan_cache.eviction")
+                metrics.count("engine.plan_cache.eviction")
             return compiled
 
     def __len__(self) -> int:
@@ -153,7 +153,7 @@ class BuildCache:
             if hit is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                tracing.count("engine.build_cache.hit")
+                metrics.count("engine.build_cache.hit")
                 return hit
         prepared = builder()  # hash+sort outside the lock (device work)
         with self._lock:
@@ -161,15 +161,15 @@ class BuildCache:
             if racer is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                tracing.count("engine.build_cache.hit")
+                metrics.count("engine.build_cache.hit")
                 return racer
             self.misses += 1
-            tracing.count("engine.build_cache.miss")
+            metrics.count("engine.build_cache.miss")
             self._entries[key] = prepared
             while len(self._entries) > self.maxsize:
                 self._entries.popitem(last=False)
                 self.evictions += 1
-                tracing.count("engine.build_cache.eviction")
+                metrics.count("engine.build_cache.eviction")
             return prepared
 
     def __len__(self) -> int:

@@ -17,11 +17,13 @@ prove predicate pushdown actually pruned I/O.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax.numpy as jnp
 
 from ..columnar import Table
+from ..utils import metrics
 from ..utils.tracing import op_scope
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
                    Sort, TopK)
@@ -231,7 +233,8 @@ def _interp_chain(seg, t: Table, stats: dict) -> Table:
     return t
 
 
-def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
+def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx,
+                  node: Optional[PlanNode] = None) -> Table:
     """Run one fused segment: materialize its input (a breaker boundary),
     then one jitted program over the whole chain."""
     from . import segment as sg
@@ -239,6 +242,14 @@ def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     # interior chain nodes never pass through _exec; keep the node count
     # meaning "plan nodes executed" either way
     stats["nodes"] += len(seg.chain) - (0 if seg.agg is not None else 1)
+    qm = metrics.current()
+    if qm is not None and node is not None \
+            and all(c is not seg.input for c in node.children()):
+        # the chain collapses into one program, so the segment root's
+        # rows_in is the breaker-boundary input (unless the input IS the
+        # direct child, which the _exec wrapper already counts from memo)
+        qm.node_add(id(node), type(node).__name__.lower(),
+                    rows_in=inp.num_rows)
     if not sg.runtime_eligible(seg, inp):
         return _interp_chain(seg, inp, stats)
     compiled = sg.SEGMENT_CACHE.get(seg, inp)
@@ -253,20 +264,22 @@ def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     if id(node) in memo:
         return memo[id(node)]
     stats["nodes"] += 1
+    qm = metrics.current()
+    t0 = time.perf_counter() if qm is not None else 0.0
     with op_scope(f"engine.{type(node).__name__.lower()}"):
         if isinstance(node, Scan):
             out = _scan_table(node, stats)
         elif isinstance(node, Filter):
             seg = ctx.segment_for(node)
             if seg is not None:
-                out = _exec_segment(seg, memo, stats, ctx)
+                out = _exec_segment(seg, memo, stats, ctx, node)
             else:
                 out = _filter_table(_exec(node.child, memo, stats, ctx),
                                     node.predicate)
         elif isinstance(node, Project):
             seg = ctx.segment_for(node)
             if seg is not None:
-                out = _exec_segment(seg, memo, stats, ctx)
+                out = _exec_segment(seg, memo, stats, ctx, node)
             else:
                 out = _exec(node.child, memo, stats,
                             ctx).select(list(node.columns))
@@ -282,7 +295,7 @@ def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
             else:
                 seg = ctx.segment_for(node)
                 if seg is not None:
-                    out = _exec_segment(seg, memo, stats, ctx)
+                    out = _exec_segment(seg, memo, stats, ctx, node)
                 else:
                     out = _groupby(_exec(node.child, memo, stats, ctx), node)
         elif isinstance(node, Sort):
@@ -299,6 +312,16 @@ def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
             out = _exec_topk(node, memo, stats, ctx)
         else:
             raise TypeError(f"unknown plan node {type(node).__name__}")
+    if qm is not None:
+        # rows_in from the memoized children: on the streamed path the
+        # per-chunk re-walk resolves the scan from the chunk overlay, so
+        # accumulated rows_in IS the per-chunk row flow
+        qm.node_add(id(node), type(node).__name__.lower(),
+                    calls=1, wall_s=time.perf_counter() - t0,
+                    rows_out=out.num_rows,
+                    rows_in=sum(memo[id(c)].num_rows
+                                for c in node.children()
+                                if id(c) in memo))
     memo[id(node)] = out
     return out
 
@@ -405,16 +428,28 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                                                     stats, ctx))
             else:
                 stats["nodes"] += len(seg.chain)  # agg counted by _exec
+                qm = metrics.current()
                 preps = first_preps
                 for chunk, nvalid in _chain_one(first, it) \
                         if first is not None else ():
                     stats["chunks"] += 1
+                    tc0 = time.perf_counter() if qm is not None else 0.0
                     if fused:  # chunks after the first hit the cache
                         preps = _get_builds(joins, build_tables)
                     fused_compiled = sg.SEGMENT_CACHE.get(seg, chunk,
                                                           build_tables)
                     with op_scope("engine.fused_segment"):
                         fused.append(fused_compiled(chunk, nvalid, preps))
+                    if qm is not None:
+                        # per-chunk latency is dispatch time — the fused
+                        # loop never syncs per chunk, by design
+                        dt = time.perf_counter() - tc0
+                        qm.node_add(id(agg), "aggregate", chunks=1,
+                                    rows_in=int(nvalid),
+                                    padded_rows=int(chunk.num_rows - nvalid))
+                        metrics.observe("engine.stream.chunk_latency_s", dt)
+                        metrics.observe("engine.stream.chunk_rows",
+                                        int(nvalid))
                 if fused:
                     stats["fused_segments"] += 1
         else:
@@ -474,10 +509,19 @@ def _stream_partial(agg: Aggregate, scan: Scan, chunk: Table, memo: dict,
     """Interpreted per-chunk partial: re-walk the scan-dependent subtree
     with the chunk standing in for the scan, then a compacting groupby."""
     stats["chunks"] += 1
+    qm = metrics.current()
+    tc0 = time.perf_counter() if qm is not None else 0.0
     sub = _ChunkMemo(memo)
     sub[id(scan)] = chunk
     t = _exec(agg.child, sub, stats, ctx)
-    return [_groupby(t, agg)] if t.num_rows else []
+    out = [_groupby(t, agg)] if t.num_rows else []
+    if qm is not None:
+        qm.node_add(id(agg), "aggregate", chunks=1,
+                    rows_in=chunk.num_rows)
+        metrics.observe("engine.stream.chunk_latency_s",
+                        time.perf_counter() - tc0)
+        metrics.observe("engine.stream.chunk_rows", chunk.num_rows)
+    return out
 
 
 def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
@@ -519,14 +563,22 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     buf: Optional[Table] = None   # current top rows (<= k), sorted
     buf_words: list = []          # their u64 sort words (incl. tiebreak)
     rows_seen = 0
+    qm = metrics.current()
     try:
         for chunk in reader:
             stats["chunks"] += 1
+            tc0 = time.perf_counter() if qm is not None else 0.0
+            if qm is not None:
+                qm.node_add(id(node), "topk", chunks=1,
+                            rows_in=chunk.num_rows)
             sub = _ChunkMemo(memo)
             sub[id(scan)] = chunk
             t = _exec(node.child, sub, stats, ctx)
             n = t.num_rows
             if n == 0:
+                if qm is not None:
+                    metrics.observe("engine.stream.chunk_latency_s",
+                                    time.perf_counter() - tc0)
                 continue
             words = encode_keys([SortKey(t[c], ascending=a)
                                  for c, a in node.keys])
@@ -543,6 +595,10 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
             keep = order[:min(node.n, order.shape[0])]
             buf = gather_table(cand_t, keep)
             buf_words = [w[keep] for w in cand_w]
+            if qm is not None:
+                metrics.observe("engine.stream.chunk_latency_s",
+                                time.perf_counter() - tc0)
+                metrics.observe("engine.stream.chunk_rows", chunk.num_rows)
     finally:
         reader.close()
     stats["row_groups_pruned"] += reader.groups_pruned
@@ -581,4 +637,11 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
                    fuse=config.fuse if fused is None else bool(fused),
                    prefetch=config.prefetch if prefetch is None
                    else int(prefetch))
-    return _exec(plan, {}, stats, ctx)
+    # one QueryMetrics per top-level execute (nested/re-entrant executes
+    # attribute into the enclosing query); SRJT_METRICS=0 skips entirely
+    with metrics.maybe_query(
+            f"execute:{type(plan).__name__.lower()}") as qm:
+        out = _exec(plan, {}, stats, ctx)
+        if qm is not None:
+            qm.note_stats(stats)
+    return out

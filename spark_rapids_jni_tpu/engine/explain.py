@@ -1,0 +1,157 @@
+"""EXPLAIN ANALYZE: execute a plan under a QueryMetrics and render the DAG.
+
+The Spark-UI SQLMetrics analog for this engine: ``explain_analyze(plan)``
+optimizes the plan, runs it inside its own ``utils.metrics.QueryMetrics``
+context, and renders the optimized DAG as an indented tree where every node
+line carries the span the executor recorded for it — calls, wall time, rows
+in/out, chunk count, padded-row waste — plus a query-level footer with the
+execution stats, per-query cache attribution (hits/misses the THIS query
+caused, consistent with the flat ``tracing`` counters), host-sync count,
+and stream histograms.
+
+The report object keeps the structured form (``nodes``, ``summary``,
+``result``) so tests and tools can assert on totals instead of scraping
+the rendered text.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..columnar import Table
+from ..utils import metrics
+from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
+                   Sort, TopK)
+
+
+def _describe(node: PlanNode) -> str:
+    """One-line logical description (the EXPLAIN half)."""
+    if isinstance(node, Scan):
+        bits = [repr(node.path)]
+        if node.columns:
+            bits.append(f"columns={list(node.columns)}")
+        if node.predicate is not None:
+            bits.append(f"predicate={node.predicate}")
+        if node.chunk_bytes:
+            bits.append(f"chunk_bytes={node.chunk_bytes}")
+        return f"Scan({', '.join(bits)})"
+    if isinstance(node, Filter):
+        return f"Filter({node.predicate})"
+    if isinstance(node, Project):
+        return f"Project({list(node.columns)})"
+    if isinstance(node, Join):
+        return (f"Join(how={node.how!r}, {list(node.left_keys)} = "
+                f"{list(node.right_keys)})")
+    if isinstance(node, Aggregate):
+        return (f"Aggregate(keys={list(node.keys)}, "
+                f"aggs={[(c, op) for c, op in node.aggs]})")
+    if isinstance(node, Sort):
+        return f"Sort({list(node.keys)})"
+    if isinstance(node, Limit):
+        return f"Limit({node.n})"
+    if isinstance(node, TopK):
+        return f"TopK(n={node.n}, keys={list(node.keys)})"
+    return type(node).__name__
+
+
+def _annotate(span: Optional[dict]) -> str:
+    """The ANALYZE half: bracketed span fields for one node line."""
+    if span is None:
+        return "[not executed]"
+    bits = [f"calls={span['calls']}",
+            f"wall={span['wall_s'] * 1e3:.2f}ms",
+            f"rows_in={span['rows_in']}",
+            f"rows_out={span['rows_out']}"]
+    if span["chunks"]:
+        bits.append(f"chunks={span['chunks']}")
+    if span["padded_rows"]:
+        bits.append(f"padded_waste={span['padded_rows']}")
+    if span["host_syncs"]:
+        bits.append(f"host_syncs={span['host_syncs']}")
+    return "[" + " ".join(bits) + "]"
+
+
+@dataclass
+class ExplainReport:
+    """Structured EXPLAIN ANALYZE output; ``str(report)`` is the tree."""
+
+    text: str
+    nodes: list = field(default_factory=list)   # topo order, root last
+    summary: dict = field(default_factory=dict)  # QueryMetrics.summary()
+    result: Optional[Table] = None
+
+    def __str__(self) -> str:
+        return self.text
+
+    @property
+    def total_chunks(self) -> int:
+        return sum(n["metrics"]["chunks"] for n in self.nodes
+                   if n["metrics"] is not None)
+
+
+def _render(root: PlanNode, spans: dict) -> str:
+    lines: list[str] = []
+    seen: set[int] = set()
+
+    def walk(node: PlanNode, depth: int) -> None:
+        pad = "  " * depth
+        if id(node) in seen:
+            lines.append(f"{pad}{type(node).__name__} (shared, see above)")
+            return
+        seen.add(id(node))
+        lines.append(f"{pad}{_describe(node)}  "
+                     f"{_annotate(spans.get(id(node)))}")
+        for child in node.children():
+            walk(child, depth + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)
+
+
+def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
+                    fused: Optional[bool] = None,
+                    prefetch: Optional[int] = None) -> ExplainReport:
+    """Optimize + execute ``plan`` and report per-node metrics.
+
+    ``fused``/``prefetch`` pass through to ``execute`` (so both executor
+    modes can be profiled on the same plan).  With ``SRJT_METRICS=0`` the
+    plan still runs and the tree still renders, but node annotations and
+    the summary are empty.
+    """
+    from .executor import execute, new_stats
+    from .optimizer import optimize
+
+    opt = optimize(plan)
+    if stats is None:
+        stats = new_stats()
+    qm = None
+    with metrics.query(f"explain:{type(opt).__name__.lower()}") as q:
+        qm = q
+        out = execute(opt, stats, fused=fused, prefetch=prefetch)
+        if q is not None:
+            q.note_stats(stats)
+    spans = dict(qm.node_spans) if qm is not None else {}
+    summary = qm.summary() if qm is not None else {}
+
+    from .plan import topo_nodes
+    nodes = [{"label": type(n).__name__.lower(),
+              "desc": _describe(n),
+              "metrics": None if id(n) not in spans else dict(spans[id(n)])}
+             for n in topo_nodes(opt)]
+
+    text = _render(opt, spans)
+    if summary:
+        foot = [f"-- query {summary['name']} "
+                f"wall={summary['wall_s'] * 1e3:.2f}ms "
+                f"nodes={stats['nodes']} chunks={stats['chunks']} "
+                f"streamed={stats['streamed']} "
+                f"fused_segments={stats['fused_segments']}"]
+        cache_counters = {k: v for k, v in summary["counters"].items()
+                          if ".cache" in k or k == "engine.host_sync"}
+        if cache_counters:
+            foot.append("-- counters (this query): " + " ".join(
+                f"{k}={v}" for k, v in sorted(cache_counters.items())))
+        text = text + "\n" + "\n".join(foot)
+    return ExplainReport(text=text, nodes=nodes, summary=summary,
+                         result=out)

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -43,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column, Table
-from ..utils import tracing
+from ..utils import metrics
 from ..utils.config import config
 from .plan import (Aggregate, Filter, Join, PlanNode, Project, expr_columns,
                    topo_nodes)
@@ -399,7 +400,23 @@ class CompiledSegment:
     def __call__(self, table: Table, nvalid=None, prepared=()):
         self.calls += 1
         nv = jnp.int32(table.num_rows if nvalid is None else nvalid)
-        return self.jfn(table, nv, tuple(prepared))
+        if not metrics.enabled():
+            return self.jfn(table, nv, tuple(prepared))
+        # compile-vs-replay tagging: ``traces`` ticks inside the traced fn,
+        # so a call that bumped it paid a trace+compile; otherwise it was a
+        # dispatch-only replay.  Durations are host-side dispatch time
+        # (jax stays async — no sync added here).
+        tr0 = self.traces
+        t0 = time.perf_counter()
+        out = self.jfn(table, nv, tuple(prepared))
+        dt = time.perf_counter() - t0
+        if self.traces > tr0:
+            metrics.count("engine.segment.compile")
+            metrics.observe("engine.segment.trace_s", dt)
+        else:
+            metrics.count("engine.segment.replay")
+            metrics.observe("engine.segment.replay_dispatch_s", dt)
+        return out
 
 
 def _resolve_dtype(name: str, table: Table, builds: tuple):
@@ -452,7 +469,7 @@ class SegmentCache:
             if hit is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                tracing.count("engine.segment_cache.hit")
+                metrics.count("engine.segment_cache.hit")
                 return hit
         key_dtypes = () if segment.agg is None else tuple(
             _resolve_dtype(k, table, builds) for k in segment.agg.keys)
@@ -462,15 +479,15 @@ class SegmentCache:
             if racer is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                tracing.count("engine.segment_cache.hit")
+                metrics.count("engine.segment_cache.hit")
                 return racer
             self.misses += 1
-            tracing.count("engine.segment_cache.miss")
+            metrics.count("engine.segment_cache.miss")
             self._entries[key] = compiled
             while len(self._entries) > self.maxsize:
                 self._entries.popitem(last=False)
                 self.evictions += 1
-                tracing.count("engine.segment_cache.eviction")
+                metrics.count("engine.segment_cache.eviction")
             return compiled
 
     def __len__(self) -> int:
@@ -500,6 +517,7 @@ def run_map_segment(compiled: CompiledSegment, table: Table,
     host sync the whole chain pays, vs one per interpreted Filter)."""
     from ..ops.selection import apply_boolean_mask
     out, live = compiled(table, nvalid)
+    metrics.host_sync()  # the boundary compaction's survivor count
     return apply_boolean_mask(out, live)
 
 
@@ -507,6 +525,7 @@ def _compact_padded(key_dtypes, kdat, kval, out_aggs, ngroups,
                     names) -> Table:
     """groupby's padded->compact tail for fused outputs (fixed-width only,
     which runtime eligibility guarantees)."""
+    metrics.host_sync()
     ng = int(ngroups)  # the one host sync
     cols = []
     for dtype, data, valid in zip(key_dtypes, kdat, kval):
@@ -552,6 +571,7 @@ def combine_partials(partials: list, compiled: CompiledSegment) -> Table:
     from .executor import _STREAM_COMBINE
     agg = compiled.segment.agg
     nk = len(agg.keys)
+    metrics.host_sync()  # the combine-sizing scalar fetch
     maxng = int(jnp.max(jnp.stack([jnp.asarray(p[4]) for p in partials])))
     cap = 64
     while cap < maxng:

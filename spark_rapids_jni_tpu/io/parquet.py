@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..columnar import Column, Table
+from ..utils import metrics
 from . import snappy
 from .thrift import decode_struct
 
@@ -1147,6 +1148,7 @@ class ParquetChunkedReader:
             if nrows == 0:
                 continue
             total = sum(h.nbytes_estimate() for h in hosts)
+            metrics.count("io.parquet.bytes_decoded", int(total))
             per_row = max(1, total // max(nrows, 1))
             step = max(1, self.limit // per_row)
             for a in range(0, nrows, step):
@@ -1155,6 +1157,8 @@ class ParquetChunkedReader:
 
     def _chunks_raw(self):
         for sl in self._host_slices():
+            metrics.count("io.parquet.chunks")
+            metrics.observe("io.parquet.chunk_rows", sl[0].num_rows)
             yield Table([h.to_column() for h in sl],
                         [h.schema.name for h in sl])
 
@@ -1170,6 +1174,8 @@ class ParquetChunkedReader:
         from .staging import stage_fixed_table
         for sl in self._host_slices():
             nrows = sl[0].num_rows
+            metrics.count("io.parquet.chunks")
+            metrics.observe("io.parquet.chunk_rows", nrows)
             if all(h.values is not None and
                    h.schema.dtype.id != dt.TypeId.DECIMAL128 for h in sl):
                 specs = [(h.schema.name, h.schema.dtype, h.values,
@@ -1221,34 +1227,53 @@ def _prefetched(gen, depth: int):
     flight."""
     import queue
     import threading
+    import time
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = threading.Event()
     DONE, FAIL = object(), object()
+    # the producer thread must attribute its decode/stall metrics to the
+    # query that opened the stream (thread-locals don't cross threads)
+    qm = metrics.current()
+    timed = metrics.enabled()
 
     def put(item) -> bool:  # False once the consumer abandoned us
+        t0 = time.perf_counter() if timed else 0.0
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
-                return True
             except queue.Full:
                 continue
+            if timed:
+                # time blocked on a full queue: the producer ran AHEAD of
+                # the consumer (healthy pipeline; idle below is the stall
+                # that costs wall time)
+                metrics.time_add("io.parquet.prefetch.producer_stall_s",
+                                 time.perf_counter() - t0)
+            return True
         return False
 
     def producer():
-        try:
-            for item in gen:
-                if not put(item):
-                    return
-            put(DONE)
-        except BaseException as e:  # surface decode errors to the consumer
-            put((FAIL, e))
+        with metrics.bind(qm):
+            try:
+                for item in gen:
+                    if not put(item):
+                        return
+                put(DONE)
+            except BaseException as e:  # surface decode errors to consumer
+                put((FAIL, e))
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
     try:
         while True:
+            t0 = time.perf_counter() if timed else 0.0
             item = q.get()
+            if timed:
+                # consumer blocked waiting on host decode: the bubble the
+                # double-buffered pipeline exists to hide
+                metrics.time_add("io.parquet.prefetch.consumer_idle_s",
+                                 time.perf_counter() - t0)
             if item is DONE:
                 break
             if isinstance(item, tuple) and len(item) == 2 \

@@ -46,6 +46,7 @@ from ..ops.hash import murmur3_hash
 from ..ops.row_conversion import (RowLayout, _build_planes,
                                   _from_planes)
 from .mesh import ROW_AXIS, axis_size
+from ..utils import metrics
 from ..utils.tracing import traced
 
 
@@ -338,6 +339,13 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
             int(partition_counts(table, mesh, list(keys), axis,
                                  key_specs=key_specs).max()))
     fn = make_shuffle(mesh, layout, key_specs, capacity, axis, donate)
+    # exchange observability: every slot of the padded all_to_all crosses
+    # the interconnect whether live or not, so slots x row_size IS the
+    # wire traffic (the padding_efficiency ratio bench.py reports)
+    metrics.count("parallel.shuffle.exchanges")
+    metrics.count("parallel.shuffle.exchange_bytes",
+                  ndev * ndev * capacity * layout.row_size)
+    metrics.observe("parallel.shuffle.capacity_rows", capacity)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
     planes_in, ok, overflow = fn(datas, masks, live)
@@ -381,6 +389,9 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
         out = shuffle_table_padded(tbl, mesh, list(keys), capacity=capacity,
                                    axis=axis, live=live)
         inflight.append(out)
+        # dispatch-ahead depth: how many exchanges sit in the device queue
+        # in front of the consumer (the pipeline's high-water mark)
+        metrics.gauge_max("parallel.shuffle.dispatch_ahead", len(inflight))
         if len(inflight) > max(0, int(depth)):
             yield inflight.popleft()
     while inflight:

@@ -18,6 +18,7 @@ environment flags read once at import:
 | ``SRJT_FUSE_JOIN``    | ``1``   | fuse scan-independent-build joins into streamed chunk programs |
 | ``SRJT_TOPK``         | ``1``   | streaming top-k for ORDER BY ... LIMIT (TopK plans) |
 | ``SRJT_BUILD_CACHE``  | ``32``  | prepared-join-build cache capacity (entries) |
+| ``SRJT_METRICS``      | ``1``   | query-scoped metrics collection (spans/histograms/gauges, utils/metrics.py) |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -27,7 +28,7 @@ from __future__ import annotations
 
 import logging
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, fields
 
 
 def _bool_flag(name: str, default: bool) -> bool:
@@ -60,6 +61,7 @@ class Config:
     fuse_join: bool = True       # probe-join fusion on the streamed path
     topk: bool = True            # streaming top-k execution of TopK plans
     build_cache: int = 32        # prepared-build cache capacity (entries)
+    metrics: bool = True         # query-scoped metrics (utils/metrics.py)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -75,6 +77,7 @@ class Config:
             fuse_join=_bool_flag("SRJT_FUSE_JOIN", True),
             topk=_bool_flag("SRJT_TOPK", True),
             build_cache=_int_flag("SRJT_BUILD_CACHE", 32, minimum=1),
+            metrics=_bool_flag("SRJT_METRICS", True),
         )
 
 
@@ -82,27 +85,30 @@ config = Config.from_env()
 
 
 def refresh() -> Config:
-    """Re-read flags from the environment (returns the live singleton)."""
-    global config
+    """Re-read flags from the environment (returns the live singleton).
+
+    Copies every dataclass field, so a flag added to ``Config`` is
+    refresh-visible automatically instead of needing a hand-maintained
+    assignment here (where ``SRJT_METRICS`` would have been dropped).
+    """
     new = Config.from_env()
-    config.trace = new.trace
-    config.pallas = new.pallas
-    config.log_level = new.log_level
-    config.leak_debug = new.leak_debug
-    config.fuse = new.fuse
-    config.prefetch = new.prefetch
-    config.plan_cache = new.plan_cache
-    config.segment_cache = new.segment_cache
-    config.fuse_join = new.fuse_join
-    config.topk = new.topk
-    config.build_cache = new.build_cache
-    logger().setLevel(config.log_level)
+    for f in fields(Config):
+        setattr(config, f.name, getattr(new, f.name))
+    logger()  # re-applies the (possibly changed) level
     return config
 
 
 def logger() -> logging.Logger:
-    """The package logger (analog of the reference's slf4j-api single dep)."""
+    """The package logger (analog of the reference's slf4j-api single dep).
+
+    A ``NullHandler`` keeps library log records from falling through to
+    lastResort when the host app never configured logging, and the level
+    is applied on EVERY call — a host app that configures root logging
+    before importing us must not freeze our level at the import-time
+    default.
+    """
     log = logging.getLogger("spark_rapids_jni_tpu")
-    if not log.handlers:
-        log.setLevel(config.log_level)
+    if not any(isinstance(h, logging.NullHandler) for h in log.handlers):
+        log.addHandler(logging.NullHandler())
+    log.setLevel(config.log_level)
     return log

@@ -1,0 +1,360 @@
+"""Query-scoped metrics: spans, histograms, gauges over the flat counters.
+
+``utils.tracing`` gives the process flat monotonic counters (the
+metrics-registry analog of the reference's NVTX-range toggles); this module
+adds the attribution layer the Spark RAPIDS plugin gets from per-operator
+SQLMetrics: a ``QueryMetrics`` context that collects per-plan-node spans
+(wall time, rows in/out, chunk count, padded-vs-live row waste, host-sync
+count), per-query counter attribution, and lock-protected histograms and
+gauges keyed by dotted name so concurrent queries never collide.
+
+Three consumers sit on top (docs/OBSERVABILITY.md):
+
+- ``engine.explain_analyze(plan)`` renders the optimized DAG annotated
+  with the spans recorded here (the EXPLAIN ANALYZE analog).
+- The bridge's ``OP_METRICS`` reply embeds ``snapshot()`` so JNI-side
+  callers can poll counters + histograms + per-query summaries.
+- ``bench.py`` embeds ``snapshot()`` into its emitted JSON so BENCH_*.json
+  carries attribution, not just totals.
+
+Collection is gated by ``SRJT_METRICS`` (default on): every entry point is
+cheap dict/``perf_counter`` work — no device syncs — and with the flag off
+each returns immediately, restoring the uninstrumented fast path.  The
+pre-existing flat counters (``tracing.count``) stay on unconditionally, as
+they always were.  ``SRJT_TRACE=1`` layers Perfetto ``TraceAnnotation``s
+(``tracing.op_scope``) on top of the same span names.
+
+Threading: the active query context is a thread-local; code that fans work
+out to helper threads (the chunked reader's prefetch producer) captures
+``current()`` and re-enters it with ``bind(qm)`` so producer-side metrics
+still attribute to the query that spawned them.  ``QueryMetrics`` carries
+its own lock, so attribution from any bound thread is safe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import math
+import threading
+import time
+from collections import deque
+
+from . import tracing
+from .config import config
+
+# -- registries -------------------------------------------------------------
+#
+# Histograms and gauges mirror the tracing counter registry: process-wide,
+# dotted-name keyed, one lock.  Histogram values bucket into powers of two
+# (the chunk-row-bucket convention io/staging.py already uses), which keeps
+# the bucket set tiny without pre-declaring ranges per metric.
+
+_lock = threading.Lock()
+_hists: dict[str, dict] = {}
+_gauges: dict[str, float] = {}
+
+#: completed-query summaries, newest last (the bridge/bench export window)
+_RECENT_LIMIT = 32
+_recent: "deque[dict]" = deque(maxlen=_RECENT_LIMIT)
+
+_tls = threading.local()
+_qids = itertools.count(1)
+
+
+def enabled() -> bool:
+    """Live SRJT_METRICS gate (config singleton, refresh()-tunable)."""
+    return config.metrics
+
+
+def _bucket_le(value: float) -> float:
+    """Smallest power-of-two upper bound for ``value`` (0.0 for <= 0)."""
+    v = float(value)
+    if v <= 0.0:
+        return 0.0
+    return 2.0 ** math.ceil(math.log2(v))
+
+
+def _hist_add(hists: dict, name: str, value: float) -> None:
+    h = hists.get(name)
+    if h is None:
+        h = hists[name] = {"count": 0, "sum": 0.0,
+                           "min": None, "max": None, "buckets": {}}
+    v = float(value)
+    h["count"] += 1
+    h["sum"] += v
+    h["min"] = v if h["min"] is None else min(h["min"], v)
+    h["max"] = v if h["max"] is None else max(h["max"], v)
+    le = _bucket_le(v)
+    h["buckets"][le] = h["buckets"].get(le, 0) + 1
+
+
+def _hist_dump(h: dict) -> dict:
+    """JSON-friendly histogram copy: buckets as sorted [le, count] pairs."""
+    return {"count": h["count"], "sum": h["sum"],
+            "min": h["min"], "max": h["max"],
+            "buckets": sorted([le, n] for le, n in h["buckets"].items())}
+
+
+def _hist_load(d: dict) -> dict:
+    return {"count": d["count"], "sum": d["sum"],
+            "min": d["min"], "max": d["max"],
+            "buckets": {float(le): n for le, n in d["buckets"]}}
+
+
+# -- per-query context ------------------------------------------------------
+
+_NODE_FIELDS = ("calls", "wall_s", "rows_in", "rows_out", "chunks",
+                "padded_rows", "host_syncs")
+
+
+class QueryMetrics:
+    """One query's attribution: node spans, counters, histograms, timers.
+
+    Node spans are keyed by the caller's choice (the executor uses
+    ``id(node)`` within one optimized plan) and accumulate across calls —
+    a per-chunk re-walk of the scan-dependent subtree adds one call per
+    chunk to each node it touches, so span totals ARE the per-node chunk
+    and row flow.
+    """
+
+    __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
+                 "node_spans", "hists", "timers", "_lock")
+
+    def __init__(self, name: str = ""):
+        self.qid = next(_qids)
+        self.name = name or f"q{self.qid}"
+        self.t0 = time.perf_counter()
+        self.wall_s: float | None = None
+        self.stats: dict = {}
+        self.counters: dict[str, int] = {}
+        self.node_spans: dict = {}
+        self.hists: dict[str, dict] = {}
+        self.timers: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            _hist_add(self.hists, name, value)
+
+    def add_time(self, name: str, dt: float) -> None:
+        with self._lock:
+            self.timers[name] = self.timers.get(name, 0.0) + dt
+
+    def node_add(self, key, label: str, **fields) -> None:
+        """Accumulate span fields (``_NODE_FIELDS``) onto node ``key``."""
+        with self._lock:
+            r = self.node_spans.get(key)
+            if r is None:
+                r = self.node_spans[key] = dict.fromkeys(_NODE_FIELDS, 0)
+                r["wall_s"] = 0.0
+                r["label"] = label
+            for k, v in fields.items():
+                r[k] += v
+
+    @contextlib.contextmanager
+    def node_span(self, key, label: str):
+        """Wall-clock span for one execution of node ``key``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.node_add(key, label, calls=1,
+                          wall_s=time.perf_counter() - t0)
+
+    def host_sync(self, n: int = 1, key=None, label: str = "") -> None:
+        self.count("engine.host_sync", n)
+        if key is not None:
+            self.node_add(key, label, host_syncs=n)
+
+    def note_stats(self, stats: dict) -> None:
+        self.stats = dict(stats)
+
+    def finish(self) -> None:
+        if self.wall_s is None:
+            self.wall_s = time.perf_counter() - self.t0
+
+    def summary(self) -> dict:
+        """JSON-ready snapshot (safe to call live or after ``finish``)."""
+        with self._lock:
+            wall = self.wall_s if self.wall_s is not None \
+                else time.perf_counter() - self.t0
+            nodes = [{k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in r.items()} for r in self.node_spans.values()]
+            return {"qid": self.qid, "name": self.name,
+                    "wall_s": round(wall, 6),
+                    "stats": dict(self.stats),
+                    "counters": dict(self.counters),
+                    "timers": {k: round(v, 6)
+                               for k, v in self.timers.items()},
+                    "histograms": {k: _hist_dump(h)
+                                   for k, h in self.hists.items()},
+                    "nodes": nodes}
+
+
+def current() -> QueryMetrics | None:
+    """The query context bound to this thread (None outside any query)."""
+    return getattr(_tls, "q", None)
+
+
+@contextlib.contextmanager
+def query(name: str = ""):
+    """Open a query context on this thread; records its summary on exit.
+
+    Yields ``None`` (and collects nothing) when ``SRJT_METRICS=0``.
+    """
+    if not config.metrics:
+        yield None
+        return
+    qm = QueryMetrics(name)
+    prev = current()
+    _tls.q = qm
+    try:
+        yield qm
+    finally:
+        _tls.q = prev
+        qm.finish()
+        with _lock:
+            _recent.append(qm.summary())
+
+
+@contextlib.contextmanager
+def maybe_query(name: str = ""):
+    """``query(name)`` unless one is already active on this thread.
+
+    Yields the NEW context or ``None`` — never the enclosing one — so
+    callers know whether they own the stats/summary hookup.
+    """
+    if not config.metrics or current() is not None:
+        yield None
+        return
+    with query(name) as qm:
+        yield qm
+
+
+@contextlib.contextmanager
+def bind(qm: QueryMetrics | None):
+    """Re-enter a captured query context on a helper thread."""
+    prev = current()
+    _tls.q = qm
+    try:
+        yield qm
+    finally:
+        _tls.q = prev
+
+
+# -- module-level recording -------------------------------------------------
+
+def count(name: str, n: int = 1) -> int:
+    """Flat counter tick (always on) + active-query attribution."""
+    v = tracing.count(name, n)
+    q = current()
+    if q is not None:
+        q.count(name, n)
+    return v
+
+
+def observe(name: str, value: float) -> None:
+    """Record ``value`` into histogram ``name`` (global + active query)."""
+    if not config.metrics:
+        return
+    with _lock:
+        _hist_add(_hists, name, value)
+    q = current()
+    if q is not None:
+        q.observe(name, value)
+
+
+def time_add(name: str, dt: float) -> None:
+    """Accumulate a duration gauge (global) + per-query timer."""
+    if not config.metrics:
+        return
+    with _lock:
+        _gauges[name] = _gauges.get(name, 0.0) + dt
+    q = current()
+    if q is not None:
+        q.add_time(name, dt)
+
+
+def gauge_set(name: str, value: float) -> None:
+    if not config.metrics:
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+def gauge_max(name: str, value: float) -> None:
+    """Keep the high-water mark of ``name`` (e.g. dispatch-ahead depth)."""
+    if not config.metrics:
+        return
+    with _lock:
+        if value > _gauges.get(name, float("-inf")):
+            _gauges[name] = value
+
+
+def host_sync(n: int = 1, key=None, label: str = "") -> None:
+    """Record a deliberate device->host sync point (attributed if keyed)."""
+    if not config.metrics:
+        return
+    tracing.count("engine.host_sync", n)
+    q = current()
+    if q is not None:
+        q.host_sync(n, key=key, label=label)
+
+
+# -- snapshots / test isolation ---------------------------------------------
+
+def histograms_snapshot(prefix: str = "") -> dict:
+    with _lock:
+        return {k: _hist_dump(h) for k, h in _hists.items()
+                if k.startswith(prefix)}
+
+
+def gauges_snapshot(prefix: str = "") -> dict:
+    with _lock:
+        return {k: v for k, v in _gauges.items() if k.startswith(prefix)}
+
+
+def recent_summaries(limit: int | None = None) -> list:
+    """Completed-query summaries, oldest first (bounded window)."""
+    with _lock:
+        out = list(_recent)
+    return out if limit is None else out[-limit:]
+
+
+def snapshot(prefix: str = "") -> dict:
+    """The full export body: counters + histograms + gauges + queries."""
+    return {"counters": tracing.counters_snapshot(prefix),
+            "histograms": histograms_snapshot(prefix),
+            "gauges": gauges_snapshot(prefix),
+            "queries": recent_summaries()}
+
+
+def reset(prefix: str = "") -> None:
+    """Zero histograms/gauges under ``prefix`` (tests isolate with this);
+    a full reset (empty prefix) also drops the recent-query window."""
+    with _lock:
+        for k in [k for k in _hists if k.startswith(prefix)]:
+            del _hists[k]
+        for k in [k for k in _gauges if k.startswith(prefix)]:
+            del _gauges[k]
+        if not prefix:
+            _recent.clear()
+
+
+def restore(hists: dict | None = None, gauges: dict | None = None,
+            prefix: str = "") -> None:
+    """Put back a ``histograms_snapshot``/``gauges_snapshot`` pair taken
+    before ``reset(prefix)`` (the ``metrics_isolation`` fixture's tail)."""
+    with _lock:
+        for k in [k for k in _hists if k.startswith(prefix)]:
+            del _hists[k]
+        for k in [k for k in _gauges if k.startswith(prefix)]:
+            del _gauges[k]
+        for k, d in (hists or {}).items():
+            _hists[k] = _hist_load(d)
+        _gauges.update(gauges or {})

@@ -94,3 +94,12 @@ def reset_counters(prefix: str = "") -> None:
     with _counters_lock:
         for k in [k for k in _counters if k.startswith(prefix)]:
             del _counters[k]
+
+
+def restore_counters(snapshot: dict, prefix: str = "") -> None:
+    """Put back a ``counters_snapshot(prefix)`` taken before a reset (the
+    tail half of the ``metrics_isolation`` test fixture)."""
+    with _counters_lock:
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]
+        _counters.update(snapshot)

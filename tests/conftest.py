@@ -34,3 +34,32 @@ def pytest_runtest_setup(item):
     if any(m.name == "requires_tpu" for m in item.iter_markers()):
         if jax.devices()[0].platform != "tpu":
             pytest.skip("requires physical TPU")
+
+
+@pytest.fixture
+def metrics_isolation():
+    """Scoped counter/histogram isolation for tests asserting exact values.
+
+    ``metrics_isolation("engine.build_cache")`` snapshots every counter,
+    histogram and gauge under the prefix, zeroes them for the test body,
+    and restores the originals on teardown — so tests that assert exact
+    counts neither see nor destroy state other tests (or the session's
+    own earlier work) accumulated.  Call it once per prefix.
+    """
+    from spark_rapids_jni_tpu.utils import metrics, tracing
+
+    saved = []
+
+    def isolate(prefix=""):
+        saved.append((prefix, tracing.counters_snapshot(prefix),
+                      metrics.histograms_snapshot(prefix),
+                      metrics.gauges_snapshot(prefix)))
+        tracing.reset_counters(prefix)
+        metrics.reset(prefix)
+        return prefix
+
+    yield isolate
+
+    for prefix, counters, hists, gauges in reversed(saved):
+        tracing.restore_counters(counters, prefix)
+        metrics.restore(hists=hists, gauges=gauges, prefix=prefix)

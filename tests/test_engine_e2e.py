@@ -167,11 +167,11 @@ def test_sort_limit_project_nodes(warehouse):
     assert out["s_store_sk"].to_pylist() == [12, 11, 10]
 
 
-def test_plan_cache_hits_without_recompile(warehouse):
+def test_plan_cache_hits_without_recompile(warehouse, metrics_isolation):
     root, sales_df, dates_df, stores_df = warehouse
     want = oracle(sales_df, dates_df, stores_df)
     pc = PlanCache()
-    tracing.reset_counters("engine.plan_cache")
+    metrics_isolation("engine.plan_cache")
 
     first = pc.get(q5_plan(root))
     assert pc.stats() == {"hits": 0, "misses": 1, "size": 1,

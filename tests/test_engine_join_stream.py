@@ -118,9 +118,10 @@ def test_streamed_join_matches_interpreter(warehouse, fname, chunk_bytes,
     assert as_rows(fused) == as_rows(interp) == as_rows(whole)
 
 
-def test_build_cache_cold_stream_hits_chunks_minus_one(warehouse):
+def test_build_cache_cold_stream_hits_chunks_minus_one(warehouse,
+                                                       metrics_isolation):
     BUILD_CACHE.clear()
-    tracing.reset_counters("engine.build_cache")
+    metrics_isolation("engine.build_cache")
     h0, m0 = BUILD_CACHE.hits, BUILD_CACHE.misses
     stats = new_stats()
     execute(optimize(join_agg_plan(warehouse / "fact.parquet",

@@ -139,7 +139,8 @@ def test_segment_traced_once_across_chunks(warehouse):
     assert max(c.calls for c in called) == stats["chunks"]
 
 
-def test_segment_cache_counters_and_env_capacity(warehouse):
+def test_segment_cache_counters_and_env_capacity(warehouse,
+                                                 metrics_isolation):
     """hit/miss/eviction counters tick (attrs + tracing registry) and
     SRJT_SEGMENT_CACHE caps a fresh cache via config refresh()."""
     from spark_rapids_jni_tpu.engine.segment import (SegmentCache,
@@ -155,7 +156,7 @@ def test_segment_cache_counters_and_env_capacity(warehouse):
 
     os.environ["SRJT_SEGMENT_CACHE"] = "1"
     config.refresh()
-    tracing.reset_counters("engine.segment_cache")
+    metrics_isolation("engine.segment_cache")
     try:
         cache = SegmentCache()  # capacity resolves from live config
         assert cache.maxsize == 1
@@ -173,8 +174,9 @@ def test_segment_cache_counters_and_env_capacity(warehouse):
     assert SegmentCache().maxsize == 256  # default restored
 
 
-def test_plan_cache_env_capacity_and_eviction_counter(warehouse):
-    tracing.reset_counters("engine.plan_cache")
+def test_plan_cache_env_capacity_and_eviction_counter(warehouse,
+                                                      metrics_isolation):
+    metrics_isolation("engine.plan_cache")
     os.environ["SRJT_PLAN_CACHE"] = "2"
     config.refresh()
     try:

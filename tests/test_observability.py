@@ -51,7 +51,9 @@ def test_named_scope_lands_in_hlo():
     t = Table([Column.from_numpy(np.arange(16, dtype=np.int64))])
     def f():
         return murmur3_hash(t).data
-    text = jax.jit(f).lower().as_text(debug_info=True)
+    # Lowered.as_text() lost its debug_info kwarg; scope names survive in
+    # the compiled module's HLO metadata instead
+    text = jax.jit(f).lower().compile().as_text()
     assert "murmur3_hash" in text
 
 
@@ -70,6 +72,11 @@ def test_bridge_metrics(tmp_path):
         assert m["errors"] == 0
         assert sum(m["ops"].values()) >= 2  # ping + import at least
         assert m["busy_s"] >= 0
+        # the OP_METRICS body now carries the engine-wide observability
+        # layer too (flat counters + SRJT_METRICS histograms/queries)
+        assert isinstance(m["counters"], dict)
+        assert isinstance(m["histograms"], dict)
+        assert isinstance(m["queries"], list)
         with pytest.raises(RuntimeError):
             c.table_meta(999999)  # bad handle -> server-side error
         m2 = c.metrics()
@@ -128,3 +135,194 @@ def test_chunked_reader_mem_debug_path(tmp_path, monkeypatch):
     total = sum(tb.num_rows for tb in
                 ParquetChunkedReader(p, pass_read_limit=8 << 10))
     assert total == n
+
+
+# ---------------------------------------------------------------------------
+# SRJT_METRICS: query-scoped spans/histograms/gauges (utils/metrics.py) and
+# EXPLAIN ANALYZE (engine/explain.py)
+
+
+@pytest.fixture(scope="module")
+def metrics_warehouse(tmp_path_factory):
+    """A chunked fact table + unique-key dim for streamed agg/join plans."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    root = tmp_path_factory.mktemp("metrics_wh")
+    rng = np.random.default_rng(7)
+    n = 4_000
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+        "v": pa.array(np.round(rng.uniform(-5.0, 50.0, n), 3)),
+    }), root / "fact.parquet", row_group_size=500)
+    pq.write_table(pa.table({
+        "dk": pa.array(np.arange(0, 40, dtype=np.int64)),
+        "dv": pa.array((np.arange(0, 40) % 5).astype(np.int64)),
+    }), root / "dim.parquet")
+    return root
+
+
+def _agg_plan(root, chunk_bytes=12_000):
+    from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Scan, col,
+                                             lit)
+    return Aggregate(
+        Filter(Scan(str(root / "fact.parquet"), chunk_bytes=chunk_bytes),
+               (">", col("v"), lit(0.0))),
+        ["k"], [("v", "sum"), (None, "count_all")], names=["s", "n"])
+
+
+def _join_plan(root, chunk_bytes=12_000):
+    from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Join, Scan,
+                                             col, lit)
+    return Aggregate(
+        Join(Filter(Scan(str(root / "fact.parquet"),
+                         chunk_bytes=chunk_bytes),
+                    (">", col("v"), lit(0.0))),
+             Scan(str(root / "dim.parquet")), ["k"], ["dk"]),
+        ["dv"], [("v", "sum"), (None, "count_all")], names=["s", "n"])
+
+
+def test_metrics_concurrent_writes_no_lost_updates(metrics_isolation):
+    """count()/observe() from worker threads racing counters_snapshot()
+    reads on the main thread: totals exact, reads monotone, no tearing."""
+    import threading
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics_isolation("test.conc")
+    n, workers = 2_000, 2
+
+    def body():
+        for _ in range(n):
+            metrics.count("test.conc.ticks")
+            metrics.observe("test.conc.vals", 1.0)
+
+    threads = [threading.Thread(target=body) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    last = 0
+    while any(t.is_alive() for t in threads):
+        v = tracing.counters_snapshot("test.conc").get("test.conc.ticks", 0)
+        assert v >= last  # snapshot under the writers: monotone, no tears
+        last = v
+        metrics.histograms_snapshot("test.conc")
+    for t in threads:
+        t.join()
+    assert tracing.counter_value("test.conc.ticks") == n * workers
+    h = metrics.histograms_snapshot("test.conc")["test.conc.vals"]
+    assert h["count"] == n * workers
+    assert h["sum"] == float(n * workers)
+
+
+def test_explain_analyze_totals_match_interpreter(metrics_warehouse):
+    """Per-node rows/chunks in the report agree with the flat stats AND
+    with the node-by-node interpreter's result, fused on and off."""
+    from spark_rapids_jni_tpu.engine import (execute, explain_analyze,
+                                             optimize)
+
+    def as_rows(t):
+        return sorted(zip(*[np.asarray(c.data, np.float64).tolist()
+                            for c in t.columns]))
+
+    want = execute(optimize(_agg_plan(metrics_warehouse)), fused=False)
+    for fused in (True, False):
+        rep = explain_analyze(_agg_plan(metrics_warehouse), fused=fused)
+        assert as_rows(rep.result) == as_rows(want)
+        root_span = rep.nodes[-1]["metrics"]  # topo order: root last
+        assert root_span is not None
+        assert root_span["rows_out"] == rep.result.num_rows
+        assert rep.summary["stats"]["chunks"] > 1
+        assert root_span["chunks"] == rep.summary["stats"]["chunks"]
+        # every scanned row enters the streaming aggregate exactly once
+        assert root_span["rows_in"] == 4_000
+        assert root_span["wall_s"] > 0
+        assert f"chunks={root_span['chunks']}" in rep.text
+
+
+def test_build_cache_hit_attributed_to_owning_query(metrics_warehouse,
+                                                    metrics_isolation):
+    """Two queries over the same streamed join: the first owns the one
+    miss, the second owns only hits — per-query counters sum to the flat
+    registry's totals."""
+    from spark_rapids_jni_tpu.engine import (BUILD_CACHE, execute, new_stats,
+                                             optimize)
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics_isolation("engine.build_cache")
+    BUILD_CACHE.clear()
+    s1, s2 = new_stats(), new_stats()
+    with metrics.query("q1") as q1:
+        execute(optimize(_join_plan(metrics_warehouse)), stats=s1,
+                fused=True)
+    with metrics.query("q2") as q2:
+        execute(optimize(_join_plan(metrics_warehouse)), stats=s2,
+                fused=True)
+    assert s1["streamed"] and s1["chunks"] > 1 and s1["fused_segments"] == 1
+    assert q1.counters["engine.build_cache.miss"] == 1
+    assert q1.counters["engine.build_cache.hit"] == s1["chunks"] - 1
+    # the second query never misses: the prepared build it reuses was paid
+    # for (and is attributed to) q1
+    assert "engine.build_cache.miss" not in q2.counters
+    assert q2.counters["engine.build_cache.hit"] == s2["chunks"]
+    flat = tracing.counters_snapshot("engine.build_cache")
+    assert flat["engine.build_cache.miss"] == 1
+    assert flat["engine.build_cache.hit"] == \
+        q1.counters["engine.build_cache.hit"] + \
+        q2.counters["engine.build_cache.hit"]
+    # the completed queries surfaced through the export path too
+    names = [q["name"] for q in metrics.recent_summaries()]
+    assert "q1" in names and "q2" in names
+
+
+def test_metrics_disabled_restores_fast_path(monkeypatch,
+                                             metrics_isolation):
+    """SRJT_METRICS=0: no query contexts, no histogram/gauge writes — but
+    the flat tracing counters stay on (they predate the metrics layer)."""
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics_isolation("test.off")
+    monkeypatch.setenv("SRJT_METRICS", "0")
+    cfg.refresh()
+    try:
+        assert not metrics.enabled()
+        with metrics.query("off") as qm:
+            assert qm is None
+            metrics.observe("test.off.h", 1.0)
+            metrics.gauge_set("test.off.g", 2.0)
+            metrics.time_add("test.off.t", 0.5)
+            metrics.count("test.off.c")
+        assert metrics.histograms_snapshot("test.off") == {}
+        assert metrics.gauges_snapshot("test.off") == {}
+        assert tracing.counter_value("test.off.c") == 1
+    finally:
+        monkeypatch.delenv("SRJT_METRICS")
+        cfg.refresh()
+    assert metrics.enabled()
+
+
+def test_config_refresh_covers_every_field(monkeypatch):
+    """refresh() iterates dataclasses.fields — a newly declared flag can't
+    be silently dropped from the hand-maintained assignment list again."""
+    import dataclasses
+    monkeypatch.setenv("SRJT_METRICS", "0")
+    c = cfg.refresh()
+    assert c.metrics is False
+    monkeypatch.delenv("SRJT_METRICS")
+    c = cfg.refresh()
+    assert c.metrics is True
+    fresh = cfg.Config.from_env()
+    for f in dataclasses.fields(cfg.Config):
+        assert getattr(cfg.config, f.name) == getattr(fresh, f.name)
+
+
+def test_logger_null_handler_and_live_level(monkeypatch):
+    """logger() installs exactly one NullHandler (library etiquette) and
+    re-applies SRJT_LOG_LEVEL on every call."""
+    import logging
+    log = cfg.logger()
+    assert any(isinstance(h, logging.NullHandler) for h in log.handlers)
+    n0 = len(log.handlers)
+    monkeypatch.setenv("SRJT_LOG_LEVEL", "debug")
+    cfg.refresh()
+    log2 = cfg.logger()
+    assert log2 is log
+    assert log2.level == logging.DEBUG
+    assert len(log2.handlers) == n0  # no duplicate handlers on re-call
+    monkeypatch.delenv("SRJT_LOG_LEVEL")
+    cfg.refresh()
+    assert cfg.logger().level == logging.WARNING

@@ -144,13 +144,13 @@ def test_regex_matches():
     assert regex_matches(col, "h(e|a)llo").to_pylist()[0] is True
 
 
-def test_regex_host_fallback_counters():
+def test_regex_host_fallback_counters(metrics_isolation):
     """The host-loop escape hatch is a perf cliff; every trip ticks the
     aggregate counter plus a per-pattern counter so fleet-wide fallback
     volume (and WHICH pattern causes it) is measurable, not just a one-off
     warning line."""
     from spark_rapids_jni_tpu.utils import tracing
-    tracing.reset_counters("ops.regex.host_fallback")
+    metrics_isolation("ops.regex.host_fallback")
     col = Column.from_pylist(["hello", "hallo", None])
     regex_matches(col, "^hell")  # rewritable: no fallback, no counter
     assert tracing.counter_value("ops.regex.host_fallback") == 0
