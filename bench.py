@@ -937,7 +937,23 @@ def smoke():
     print(json.dumps({"metric": "engine_pipeline_smoke",
                       "ok": ok,
                       "chunks": res["chunks"] if res else None,
-                      "segment_cache": res["segment_cache"] if res else None}))
+                      "segment_cache": res["segment_cache"] if res else None,
+                      # absolute latencies (machine-dependent, gate with
+                      # loose tolerance only) and dimensionless ratios
+                      # (the portable signal) for ci/bench_gate.py
+                      "latency_ms": {} if not res else {
+                          "q5_warm_fused": round(res["q5_warm_fused_ms"], 3),
+                          "q5_warm_interp": round(res["q5_warm_interp_ms"], 3),
+                          "stream_serial": round(res["stream_serial_ms"], 3),
+                          "stream_overlap": round(res["stream_overlap_ms"], 3),
+                      },
+                      "ratios": {} if not res else {
+                          "fused_vs_interp": round(res["fused_vs_interp"], 4)
+                          if res["fused_vs_interp"] else None,
+                          "overlap_vs_serial":
+                          round(res["overlap_vs_serial"], 4)
+                          if res["overlap_vs_serial"] else None,
+                      }}))
     jres = bench_engine_join(n=20_000, chunk_bytes=48_000, smoke=True)
     jok = bool(jres and jres["results_match"] and jres["join_streamed_fused"]
                and jres["topk_streamed"] and jres["build_cache_counters_ok"]
@@ -945,18 +961,55 @@ def smoke():
     print(json.dumps({"metric": "engine_join_smoke",
                       "ok": jok,
                       "chunks": jres["chunks"] if jres else None,
-                      "build_cache": jres["build_cache"] if jres else None}))
+                      "build_cache": jres["build_cache"] if jres else None,
+                      "latency_ms": {} if not jres else {
+                          "join_cached_build":
+                          round(jres["join_cached_build_ms"], 3),
+                          "topk_stream": round(jres["topk_stream_ms"], 3),
+                      },
+                      "ratios": {} if not jres else {
+                          "cached_vs_per_chunk":
+                          round(jres["cached_vs_per_chunk"], 4)
+                          if jres["cached_vs_per_chunk"] else None,
+                          "topk_vs_full_sort":
+                          round(jres["topk_vs_full_sort"], 4)
+                          if jres["topk_vs_full_sort"] else None,
+                      }}))
     # third line: the observability layer itself — every execute() above ran
     # under a QueryMetrics, so with SRJT_METRICS on the snapshot must carry
     # per-query summaries (premerge greps this line for the block)
-    from spark_rapids_jni_tpu.utils import metrics
+    from spark_rapids_jni_tpu.utils import metrics, timeline
     snap = metrics.snapshot()
     mok = (not metrics.enabled()) or bool(snap["queries"])
     print(json.dumps({"metric": "metrics_snapshot",
                       "ok": mok,
                       "enabled": metrics.enabled(),
                       **snap}))
-    return 0 if (ok and jok and mok) else 1
+    # fourth line: the timeline layer — with SRJT_TIMELINE on, the smoke
+    # queries above must have produced trace events, and the dump (to
+    # SRJT_TIMELINE_OUT, or a tempfile) must be valid Chrome trace JSON
+    tok, tpath, tevents = True, None, 0
+    if timeline.enabled():
+        import tempfile
+        tpath = os.environ.get("SRJT_TIMELINE_OUT")
+        if not tpath:
+            tpath = os.path.join(tempfile.gettempdir(),
+                                 f"srjt-smoke-timeline-{os.getpid()}.json")
+        trace = timeline.export()
+        tevents = sum(1 for e in trace["traceEvents"] if e["ph"] != "M")
+        timeline.dump(tpath)
+        try:
+            with open(tpath) as f:
+                reloaded = json.load(f)
+            tok = bool(tevents > 0 and reloaded["traceEvents"])
+        except Exception:
+            tok = False
+    print(json.dumps({"metric": "timeline",
+                      "ok": tok,
+                      "enabled": timeline.enabled(),
+                      "path": tpath,
+                      "events": tevents}))
+    return 0 if (ok and jok and mok and tok) else 1
 
 
 def main():

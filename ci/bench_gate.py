@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""Bench regression gate: diff a bench.py artifact against the ``_gate``
+references in BENCH_BASELINES.json.
+
+A bench artifact is the stdout of ``bench.py`` or ``bench.py --smoke``:
+one JSON object per line, each carrying a ``metric`` name.  This gate
+flattens every line into dotted keys (``<metric>.<path.to.value>``),
+looks up each key in the ``_gate.metrics`` table, and classifies it:
+
+- ``ok``          within tolerance of the reference
+- ``improved``    better than the reference by more than the tolerance
+- ``regression``  worse than the reference by more than the tolerance
+- ``missing``     a gated key the artifact did not produce (treated as a
+                  regression: the bench silently dropped a metric)
+
+Keys present in the artifact but not in ``_gate.metrics`` are ignored —
+the gate only watches what was deliberately enrolled.  References are
+NOT the pinned ``vs_baseline`` denominators (those are measured once and
+never touched); ``_gate`` is a separate, freely retunable table.
+
+Report-only by default: always prints the table and a JSON summary line,
+exits 0.  ``--enforce`` makes regressions (and missing gated keys) exit
+non-zero — premerge runs report-only while tolerances are tuned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINES = os.path.join(_REPO_ROOT, "BENCH_BASELINES.json")
+
+
+def flatten(obj, prefix=""):
+    """{'a': {'b': 1}, 'c': 2} -> {'a.b': 1, 'c': 2}; lists are skipped
+    (no gated metric is a list, and histogram buckets should not be)."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out.update(flatten(v, key))
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[key] = float(v)
+    return out
+
+
+def parse_artifact(text: str) -> dict:
+    """Flatten every JSON line of a bench run into one dotted-key map,
+    rooted at each line's ``metric`` name.  Non-JSON lines (warnings,
+    progress chatter) are skipped."""
+    flat: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict):
+            continue
+        metric = obj.get("metric")
+        root = str(metric) if metric else ""
+        flat.update(flatten(obj, root))
+        # the headline value lives at "<metric>.value"; flatten() already
+        # produces that, so nothing special to do
+    return flat
+
+
+def load_gate(path: str) -> tuple[dict, float]:
+    with open(path) as f:
+        pins = json.load(f)
+    gate = pins.get("_gate", {})
+    return (gate.get("metrics", {}),
+            float(gate.get("tolerance_default", 0.25)))
+
+
+def classify(value, spec: dict, default_tol: float) -> dict:
+    tol = float(spec.get("tolerance", default_tol))
+    ref = float(spec["reference"])
+    higher = spec.get("direction", "higher") == "higher"
+    row = {"reference": ref, "tolerance": tol,
+           "direction": "higher" if higher else "lower"}
+    if value is None:
+        row.update(status="missing", value=None, ratio=None)
+        return row
+    ratio = (value / ref) if ref else None
+    row.update(value=value, ratio=round(ratio, 4) if ratio else None)
+    if higher:
+        if value < ref * (1 - tol):
+            row["status"] = "regression"
+        elif value > ref * (1 + tol):
+            row["status"] = "improved"
+        else:
+            row["status"] = "ok"
+    else:
+        if value > ref * (1 + tol):
+            row["status"] = "regression"
+        elif value < ref * (1 - tol):
+            row["status"] = "improved"
+        else:
+            row["status"] = "ok"
+    return row
+
+
+def run_gate(artifact_text: str, baselines_path: str,
+             tolerance: float | None = None) -> dict:
+    flat = parse_artifact(artifact_text)
+    specs, default_tol = load_gate(baselines_path)
+    if tolerance is not None:
+        default_tol = tolerance
+    rows = {key: classify(flat.get(key), spec, default_tol)
+            for key, spec in specs.items()}
+    statuses = [r["status"] for r in rows.values()]
+    return {
+        "rows": rows,
+        "checked": len(rows),
+        "ok": statuses.count("ok"),
+        "improved": statuses.count("improved"),
+        "regressions": statuses.count("regression"),
+        "missing": statuses.count("missing"),
+    }
+
+
+def render(summary: dict) -> str:
+    lines = [f"{'status':<11} {'key':<68} {'value':>12} {'ref':>12} "
+             f"{'ratio':>8} {'tol':>5}"]
+    for key, r in sorted(summary["rows"].items(),
+                         key=lambda kv: kv[1]["status"] != "regression"):
+        val = "-" if r["value"] is None else f"{r['value']:.4g}"
+        ratio = "-" if r["ratio"] is None else f"{r['ratio']:.3f}"
+        lines.append(f"{r['status']:<11} {key:<68} {val:>12} "
+                     f"{r['reference']:>12.4g} {ratio:>8} "
+                     f"{r['tolerance']:>5.2f}")
+    lines.append(f"-- gate: {summary['checked']} checked, "
+                 f"{summary['ok']} ok, {summary['improved']} improved, "
+                 f"{summary['regressions']} regressions, "
+                 f"{summary['missing']} missing")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--artifact", required=True,
+                    help="bench output file (JSON lines), or - for stdin")
+    ap.add_argument("--baselines", default=DEFAULT_BASELINES,
+                    help="BENCH_BASELINES.json carrying the _gate section")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override _gate.tolerance_default for keys "
+                         "without a per-key tolerance")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--report-only", action="store_true", default=True,
+                      help="print the report, always exit 0 (default)")
+    mode.add_argument("--enforce", action="store_true",
+                      help="exit 1 on regressions or missing gated keys")
+    args = ap.parse_args(argv)
+
+    if args.artifact == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.artifact) as f:
+            text = f.read()
+
+    summary = run_gate(text, args.baselines, args.tolerance)
+    print(render(summary))
+    print(json.dumps({"metric": "bench_gate",
+                      "enforced": bool(args.enforce),
+                      "checked": summary["checked"],
+                      "ok": summary["ok"],
+                      "improved": summary["improved"],
+                      "regressions": summary["regressions"],
+                      "missing": summary["missing"]}))
+    if args.enforce and (summary["regressions"] or summary["missing"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,10 @@ ci/premerge.sh
 # benchmarks (runs on whatever backend jax selects; TPU when present)
 python bench.py | tee target/bench-nightly.json
 
+# regression gate over the full artifact — report-only until the _gate
+# tolerances have soaked; flip to --enforce to make regressions fail
+python ci/bench_gate.py --artifact target/bench-nightly.json --report-only
+
 # wheel with provenance baked in (build/build-info ran in premerge)
 python -m pip wheel --no-deps --no-build-isolation -w target/dist . \
     || python -m pip wheel --no-deps -w target/dist .

@@ -23,13 +23,18 @@ python -m pytest tests/ -q
 # engine perf-path smoke: tiny shapes through the fused-segment and
 # double-buffered streaming paths end-to-end (correctness cross-checks,
 # no timing assertions) — keeps the bench's perf paths runnable without
-# paying full bench time in the gate.  Runs with tracing AND the metrics
-# layer forced on so the instrumented paths (spans, histograms, Perfetto
-# annotations) are exercised in-gate; the snapshot line must carry the
-# per-query summary block (docs/OBSERVABILITY.md).
+# paying full bench time in the gate.  Runs with tracing, the metrics
+# layer, AND the timeline forced on so the instrumented paths (spans,
+# histograms, Perfetto annotations, trace events) are exercised in-gate;
+# the snapshot line must carry the per-query summary block and the
+# timeline line must point at a loadable Chrome trace-event JSON
+# (docs/OBSERVABILITY.md).
+mkdir -p target
 SMOKE_OUT=$(JAX_PLATFORMS=cpu SRJT_TRACE=1 SRJT_METRICS=1 \
+    SRJT_TIMELINE=1 SRJT_TIMELINE_OUT=target/smoke-timeline.json \
     python bench.py --smoke)
 echo "$SMOKE_OUT"
+echo "$SMOKE_OUT" > target/smoke-artifact.json
 echo "$SMOKE_OUT" | python -c '
 import json, sys
 snaps = [json.loads(l) for l in sys.stdin if l.strip()]
@@ -38,7 +43,22 @@ assert snap, "bench.py --smoke emitted no metrics_snapshot line"
 assert snap[0].get("queries"), "metrics snapshot missing per-query summaries"
 assert snap[0]["ok"], "metrics snapshot not ok"
 print("metrics snapshot: %d per-query summaries" % len(snap[0]["queries"]))
+tl = [s for s in snaps if s.get("metric") == "timeline"]
+assert tl, "bench.py --smoke emitted no timeline line"
+assert tl[0]["enabled"] and tl[0]["ok"], "timeline line not ok: %r" % tl[0]
+trace = json.load(open(tl[0]["path"]))
+evs = trace["traceEvents"]
+assert evs and all("ph" in e and "name" in e for e in evs), \
+    "timeline dump is not Chrome trace-event JSON"
+assert any(e["ph"] == "X" for e in evs), "timeline has no complete spans"
+print("timeline: %d trace events at %s" % (tl[0]["events"], tl[0]["path"]))
 '
+
+# bench regression gate, report-only while tolerances are tuned: diffs the
+# smoke artifact against the _gate references in BENCH_BASELINES.json
+# (full-bench keys show as "missing" here, which report-only tolerates;
+# nightly runs the gate over the full artifact)
+python ci/bench_gate.py --artifact target/smoke-artifact.json --report-only
 
 # the driver's multi-chip entry must keep compiling + executing
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

@@ -490,11 +490,14 @@ class BridgeServer:
         # engine-wide observability: the flat monotonic counters plus the
         # SRJT_METRICS layer (histograms as [le, count] pairs, gauges, and
         # recent per-query summaries) — all JSON-native by construction
-        from ..utils import metrics, tracing
+        from ..utils import metrics, timeline, tracing
         snap["counters"] = tracing.counters_snapshot()
         snap["histograms"] = metrics.histograms_snapshot()
         snap["gauges"] = metrics.gauges_snapshot()
         snap["queries"] = metrics.recent_summaries()
+        if timeline.enabled():
+            # Chrome trace-event JSON, ready for chrome://tracing/Perfetto
+            snap["timeline"] = timeline.export()
         return json.dumps(snap).encode()
 
     def serve_forever(self) -> None:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from ..columnar import Table
 from ..utils import metrics
+from ..utils.memory import table_nbytes
 from ..utils.tracing import op_scope
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
                    Sort, TopK)
@@ -246,10 +247,10 @@ def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx,
     if qm is not None and node is not None \
             and all(c is not seg.input for c in node.children()):
         # the chain collapses into one program, so the segment root's
-        # rows_in is the breaker-boundary input (unless the input IS the
-        # direct child, which the _exec wrapper already counts from memo)
+        # rows_in/bytes_in is the breaker-boundary input (unless the input
+        # IS the direct child, which the _exec wrapper counts from memo)
         qm.node_add(id(node), type(node).__name__.lower(),
-                    rows_in=inp.num_rows)
+                    rows_in=inp.num_rows, bytes_in=table_nbytes(inp))
     if not sg.runtime_eligible(seg, inp):
         return _interp_chain(seg, inp, stats)
     compiled = sg.SEGMENT_CACHE.get(seg, inp)
@@ -313,15 +314,20 @@ def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
         else:
             raise TypeError(f"unknown plan node {type(node).__name__}")
     if qm is not None:
-        # rows_in from the memoized children: on the streamed path the
-        # per-chunk re-walk resolves the scan from the chunk overlay, so
-        # accumulated rows_in IS the per-chunk row flow
+        # rows_in/bytes_in from the memoized children: on the streamed
+        # path the per-chunk re-walk resolves the scan from the chunk
+        # overlay, so the accumulated totals ARE the per-chunk flow.
+        # bytes are buffer-metadata sums (.nbytes) — no sync.
         qm.node_add(id(node), type(node).__name__.lower(),
                     calls=1, wall_s=time.perf_counter() - t0,
                     rows_out=out.num_rows,
+                    bytes_out=table_nbytes(out),
                     rows_in=sum(memo[id(c)].num_rows
                                 for c in node.children()
-                                if id(c) in memo))
+                                if id(c) in memo),
+                    bytes_in=sum(table_nbytes(memo[id(c)])
+                                 for c in node.children()
+                                 if id(c) in memo))
     memo[id(node)] = out
     return out
 
@@ -446,10 +452,12 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                         dt = time.perf_counter() - tc0
                         qm.node_add(id(agg), "aggregate", chunks=1,
                                     rows_in=int(nvalid),
+                                    bytes_in=table_nbytes(chunk),
                                     padded_rows=int(chunk.num_rows - nvalid))
                         metrics.observe("engine.stream.chunk_latency_s", dt)
                         metrics.observe("engine.stream.chunk_rows",
                                         int(nvalid))
+                        metrics.mem_checkpoint()
                 if fused:
                     stats["fused_segments"] += 1
         else:
@@ -517,10 +525,12 @@ def _stream_partial(agg: Aggregate, scan: Scan, chunk: Table, memo: dict,
     out = [_groupby(t, agg)] if t.num_rows else []
     if qm is not None:
         qm.node_add(id(agg), "aggregate", chunks=1,
-                    rows_in=chunk.num_rows)
+                    rows_in=chunk.num_rows,
+                    bytes_in=table_nbytes(chunk))
         metrics.observe("engine.stream.chunk_latency_s",
                         time.perf_counter() - tc0)
         metrics.observe("engine.stream.chunk_rows", chunk.num_rows)
+        metrics.mem_checkpoint()
     return out
 
 
@@ -570,7 +580,8 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
             tc0 = time.perf_counter() if qm is not None else 0.0
             if qm is not None:
                 qm.node_add(id(node), "topk", chunks=1,
-                            rows_in=chunk.num_rows)
+                            rows_in=chunk.num_rows,
+                            bytes_in=table_nbytes(chunk))
             sub = _ChunkMemo(memo)
             sub[id(scan)] = chunk
             t = _exec(node.child, sub, stats, ctx)
@@ -599,6 +610,7 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
                 metrics.observe("engine.stream.chunk_latency_s",
                                 time.perf_counter() - tc0)
                 metrics.observe("engine.stream.chunk_rows", chunk.num_rows)
+                metrics.mem_checkpoint()
     finally:
         reader.close()
     stats["row_groups_pruned"] += reader.groups_pruned
@@ -644,4 +656,7 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
         out = _exec(plan, {}, stats, ctx)
         if qm is not None:
             qm.note_stats(stats)
+            # query-boundary device-memory sample: with the chunk-boundary
+            # samples above, summary["memory"] carries live + high-water
+            metrics.mem_checkpoint()
     return out

@@ -16,6 +16,8 @@ the rendered text.
 
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -23,6 +25,40 @@ from ..columnar import Table
 from ..utils import metrics
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
                    Sort, TopK)
+
+# -- roofline ceiling --------------------------------------------------------
+
+_ceiling_cache: list = [False, None]  # [loaded?, value]
+
+
+def roofline_ceiling_gbps() -> Optional[float]:
+    """The device-bandwidth ceiling per-node GB/s is judged against.
+
+    Resolution order: ``SRJT_ROOFLINE_GBPS`` env override (read every call
+    so tests can pin it), then the ``device_bandwidth_ceiling_GBps`` entry
+    pinned in BENCH_BASELINES.json at the repo root (cached after one
+    read).  Returns None when neither exists — annotations then omit
+    ``roofline_frac`` rather than inventing a ceiling.
+    """
+    env = os.environ.get("SRJT_ROOFLINE_GBPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if not _ceiling_cache[0]:
+        _ceiling_cache[0] = True
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        path = os.path.join(root, "BENCH_BASELINES.json")
+        try:
+            with open(path) as f:
+                pins = json.load(f)
+            _ceiling_cache[1] = float(
+                pins["device_bandwidth_ceiling_GBps"]["pinned_baseline"])
+        except Exception:
+            _ceiling_cache[1] = None
+    return _ceiling_cache[1]
 
 
 def _describe(node: PlanNode) -> str:
@@ -55,7 +91,23 @@ def _describe(node: PlanNode) -> str:
     return type(node).__name__
 
 
-def _annotate(span: Optional[dict]) -> str:
+def _roofline(span: dict, ceiling: Optional[float]) -> dict:
+    """Derived per-node cost columns from a span's byte accounting:
+    ``bytes_moved`` (in + out, fused-segment bytes already attributed to
+    the segment root by the executor), ``GBps`` over the node's wall
+    time, and ``roofline_frac`` against the pinned bandwidth ceiling."""
+    moved = int(span.get("bytes_in", 0)) + int(span.get("bytes_out", 0))
+    out = {"bytes_moved": moved, "GBps": None, "roofline_frac": None}
+    wall = span.get("wall_s") or 0.0
+    if moved and wall > 0:
+        gbps = moved / wall / 1e9
+        out["GBps"] = round(gbps, 3)
+        if ceiling:
+            out["roofline_frac"] = round(gbps / ceiling, 6)
+    return out
+
+
+def _annotate(span: Optional[dict], ceiling: Optional[float] = None) -> str:
     """The ANALYZE half: bracketed span fields for one node line."""
     if span is None:
         return "[not executed]"
@@ -69,6 +121,13 @@ def _annotate(span: Optional[dict]) -> str:
         bits.append(f"padded_waste={span['padded_rows']}")
     if span["host_syncs"]:
         bits.append(f"host_syncs={span['host_syncs']}")
+    rf = _roofline(span, ceiling)
+    if rf["bytes_moved"]:
+        bits.append(f"bytes_moved={rf['bytes_moved']}")
+        if rf["GBps"] is not None:
+            bits.append(f"GB/s={rf['GBps']:.3f}")
+        if rf["roofline_frac"] is not None:
+            bits.append(f"roofline_frac={rf['roofline_frac']:.6f}")
     return "[" + " ".join(bits) + "]"
 
 
@@ -90,7 +149,8 @@ class ExplainReport:
                    if n["metrics"] is not None)
 
 
-def _render(root: PlanNode, spans: dict) -> str:
+def _render(root: PlanNode, spans: dict,
+            ceiling: Optional[float] = None) -> str:
     lines: list[str] = []
     seen: set[int] = set()
 
@@ -101,7 +161,7 @@ def _render(root: PlanNode, spans: dict) -> str:
             return
         seen.add(id(node))
         lines.append(f"{pad}{_describe(node)}  "
-                     f"{_annotate(spans.get(id(node)))}")
+                     f"{_annotate(spans.get(id(node)), ceiling)}")
         for child in node.children():
             walk(child, depth + 1)
 
@@ -134,19 +194,29 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
     spans = dict(qm.node_spans) if qm is not None else {}
     summary = qm.summary() if qm is not None else {}
 
+    ceiling = roofline_ceiling_gbps()
     from .plan import topo_nodes
     nodes = [{"label": type(n).__name__.lower(),
               "desc": _describe(n),
-              "metrics": None if id(n) not in spans else dict(spans[id(n)])}
+              "metrics": None if id(n) not in spans else
+              {**spans[id(n)], **_roofline(spans[id(n)], ceiling)}}
              for n in topo_nodes(opt)]
 
-    text = _render(opt, spans)
+    text = _render(opt, spans, ceiling)
     if summary:
         foot = [f"-- query {summary['name']} "
                 f"wall={summary['wall_s'] * 1e3:.2f}ms "
                 f"nodes={stats['nodes']} chunks={stats['chunks']} "
                 f"streamed={stats['streamed']} "
                 f"fused_segments={stats['fused_segments']}"]
+        if ceiling:
+            foot[0] += f" roofline_ceiling_GBps={ceiling}"
+        mem = summary.get("memory")
+        if mem:
+            foot.append(
+                f"-- memory ({mem.get('source', 'census')}): "
+                f"live={mem.get('live_bytes', 0)} "
+                f"high_water={mem.get('high_water_bytes', 0)}")
         cache_counters = {k: v for k, v in summary["counters"].items()
                           if ".cache" in k or k == "engine.host_sync"}
         if cache_counters:

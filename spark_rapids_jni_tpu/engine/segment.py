@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column, Table
-from ..utils import metrics
+from ..utils import metrics, timeline
 from ..utils.config import config
 from .plan import (Aggregate, Filter, Join, PlanNode, Project, expr_columns,
                    topo_nodes)
@@ -400,7 +400,7 @@ class CompiledSegment:
     def __call__(self, table: Table, nvalid=None, prepared=()):
         self.calls += 1
         nv = jnp.int32(table.num_rows if nvalid is None else nvalid)
-        if not metrics.enabled():
+        if not metrics.enabled() and not timeline.enabled():
             return self.jfn(table, nv, tuple(prepared))
         # compile-vs-replay tagging: ``traces`` ticks inside the traced fn,
         # so a call that bumped it paid a trace+compile; otherwise it was a
@@ -410,12 +410,15 @@ class CompiledSegment:
         t0 = time.perf_counter()
         out = self.jfn(table, nv, tuple(prepared))
         dt = time.perf_counter() - t0
-        if self.traces > tr0:
-            metrics.count("engine.segment.compile")
-            metrics.observe("engine.segment.trace_s", dt)
-        else:
-            metrics.count("engine.segment.replay")
-            metrics.observe("engine.segment.replay_dispatch_s", dt)
+        kind = "compile" if self.traces > tr0 else "replay"
+        timeline.complete(f"engine.segment.{kind}", t0, dt)
+        if metrics.enabled():
+            if kind == "compile":
+                metrics.count("engine.segment.compile")
+                metrics.observe("engine.segment.trace_s", dt)
+            else:
+                metrics.count("engine.segment.replay")
+                metrics.observe("engine.segment.replay_dispatch_s", dt)
         return out
 
 

@@ -33,7 +33,7 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..columnar import Column, Table
-from ..utils import metrics
+from ..utils import metrics, timeline
 from . import snappy
 from .thrift import decode_struct
 
@@ -1236,6 +1236,12 @@ def _prefetched(gen, depth: int):
     # query that opened the stream (thread-locals don't cross threads)
     qm = metrics.current()
     timed = metrics.enabled()
+    # cross-thread flow arrows: producer's staging of chunk n links to the
+    # consumer's dispatch of chunk n by id.  Both sides count the same
+    # in-order sequence, so fid_base + n matches without threading ids
+    # through the queue items.
+    tl = timeline.enabled()
+    fid_base = timeline.new_flow_base() if tl else 0
 
     def put(item) -> bool:  # False once the consumer abandoned us
         t0 = time.perf_counter() if timed else 0.0
@@ -1256,15 +1262,35 @@ def _prefetched(gen, depth: int):
     def producer():
         with metrics.bind(qm):
             try:
-                for item in gen:
-                    if not put(item):
-                        return
+                if tl:
+                    it = iter(gen)
+                    n = 0
+                    while True:
+                        # span covers the host decode + staging pull for
+                        # chunk n; the flow tail starts inside it so the
+                        # arrow binds to the producer slice
+                        with timeline.span("io.parquet.produce_chunk",
+                                           {"chunk": n}):
+                            try:
+                                item = next(it)
+                            except StopIteration:
+                                break
+                            timeline.flow_start("io.parquet.chunk",
+                                                fid_base + n)
+                        if not put(item):
+                            return
+                        n += 1
+                else:
+                    for item in gen:
+                        if not put(item):
+                            return
                 put(DONE)
             except BaseException as e:  # surface decode errors to consumer
                 put((FAIL, e))
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
+    k = 0
     try:
         while True:
             t0 = time.perf_counter() if timed else 0.0
@@ -1279,6 +1305,13 @@ def _prefetched(gen, depth: int):
             if isinstance(item, tuple) and len(item) == 2 \
                     and item[0] is FAIL:
                 raise item[1]
+            if tl:
+                # the arrow head: chunk k leaves the queue for dispatch on
+                # the consumer thread (binds to the enclosing engine slice)
+                with timeline.span("io.parquet.consume_chunk",
+                                   {"chunk": k}):
+                    timeline.flow_finish("io.parquet.chunk", fid_base + k)
+                k += 1
             yield item
     finally:
         # early abandonment (LIMIT queries, consumer errors) must not

@@ -46,7 +46,7 @@ from ..ops.hash import murmur3_hash
 from ..ops.row_conversion import (RowLayout, _build_planes,
                                   _from_planes)
 from .mesh import ROW_AXIS, axis_size
-from ..utils import metrics
+from ..utils import metrics, timeline
 from ..utils.tracing import traced
 
 
@@ -348,7 +348,11 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
     metrics.observe("parallel.shuffle.capacity_rows", capacity)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
-    planes_in, ok, overflow = fn(datas, masks, live)
+    with timeline.span("parallel.shuffle.exchange",
+                       {"capacity": int(capacity),
+                        "wire_bytes": int(ndev * ndev * capacity *
+                                          layout.row_size)}):
+        planes_in, ok, overflow = fn(datas, masks, live)
     datas_out, masks_out = _from_planes(layout, list(planes_in))
     cols = [Column(dt, data=d, validity=m)
             for dt, d, m in zip(layout.schema, datas_out, masks_out)]

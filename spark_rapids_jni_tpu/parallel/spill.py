@@ -46,6 +46,7 @@ from ..ops.row_conversion import fixed_width_layout, _from_planes
 from .mesh import ROW_AXIS, axis_size
 from .shuffle import (cap_bucket, key_specs_for, make_shuffle,
                       partition_counts, _spec_columns, partition_ids_specs)
+from ..utils import metrics, timeline
 from ..utils.tracing import traced
 
 
@@ -171,25 +172,38 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
 
     total = int(np.asarray(counts).sum())
     out_datas, out_valids = _spill_buffers(st.dtypes(), total, spill_dir)
+    buffer_bytes = sum(d.nbytes for d in out_datas) + \
+        sum(v.nbytes for v in out_valids)
+    metrics.count("parallel.spill.spills")
+    metrics.count("parallel.spill.passes", npasses)
+    metrics.gauge_max("parallel.spill.buffer_bytes", buffer_bytes)
+    metrics.observe("parallel.spill.pass_capacity_rows", cap_slice)
     fn = make_shuffle(mesh, layout, key_specs, cap_slice, axis)
     written = 0
     for p in range(npasses):
         lo, hi = p * cap_slice, (p + 1) * cap_slice
         window = (rank >= lo) & (rank < hi) & live
-        planes_in, ok, ovf = fn(datas, masks, window)
-        if int(ovf):
-            raise RuntimeError(f"spill pass {p} overflow ({int(ovf)} rows)"
-                               " — counts pass disagrees with payload")
-        d_in, m_in = _from_planes(layout, list(planes_in))
-        okn = np.asarray(ok)
-        keep = np.flatnonzero(okn)
-        nlive = keep.shape[0]
-        for ci, (d, m) in enumerate(zip(d_in, m_in)):
-            dn = np.asarray(d)
-            out_datas[ci][written:written + nlive] = dn[keep] if \
-                dn.ndim == 1 else dn[keep].reshape(nlive, *dn.shape[1:])
-            out_valids[ci][written:written + nlive] = np.asarray(m)[keep]
-        written += nlive
+        with timeline.span("parallel.spill.pass",
+                           {"pass": p, "capacity": int(cap_slice)}):
+            planes_in, ok, ovf = fn(datas, masks, window)
+            if int(ovf):
+                raise RuntimeError(
+                    f"spill pass {p} overflow ({int(ovf)} rows)"
+                    " — counts pass disagrees with payload")
+            d_in, m_in = _from_planes(layout, list(planes_in))
+            okn = np.asarray(ok)
+            keep = np.flatnonzero(okn)
+            nlive = keep.shape[0]
+            for ci, (d, m) in enumerate(zip(d_in, m_in)):
+                dn = np.asarray(d)
+                out_datas[ci][written:written + nlive] = dn[keep] if \
+                    dn.ndim == 1 else dn[keep].reshape(nlive, *dn.shape[1:])
+                out_valids[ci][written:written + nlive] = \
+                    np.asarray(m)[keep]
+            written += nlive
+            metrics.count("parallel.spill.bytes_spilled",
+                          nlive * (row_bytes + len(out_valids)))
+        metrics.mem_checkpoint()
     assert written == total, (written, total)
 
     cols = []

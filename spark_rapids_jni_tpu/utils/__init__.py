@@ -1,6 +1,7 @@
 from . import bitmask
 from . import config
 from . import memory
+from . import timeline
 from . import tracing
 
-__all__ = ["bitmask", "config", "memory", "tracing"]
+__all__ = ["bitmask", "config", "memory", "timeline", "tracing"]

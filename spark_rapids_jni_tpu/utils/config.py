@@ -19,6 +19,9 @@ environment flags read once at import:
 | ``SRJT_TOPK``         | ``1``   | streaming top-k for ORDER BY ... LIMIT (TopK plans) |
 | ``SRJT_BUILD_CACHE``  | ``32``  | prepared-join-build cache capacity (entries) |
 | ``SRJT_METRICS``      | ``1``   | query-scoped metrics collection (spans/histograms/gauges, utils/metrics.py) |
+| ``SRJT_TIMELINE``     | ``0``   | in-process trace-event timeline (utils/timeline.py, Perfetto-loadable JSON) |
+| ``SRJT_TIMELINE_CAP`` | ``16384`` | timeline ring-buffer capacity (events; oldest dropped) |
+| ``SRJT_LOG_FORMAT``   | ``text``| ``json`` emits one JSON object per log line (ts/level/logger/msg + active query) |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -26,8 +29,10 @@ reads the module-level singleton.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import sys
 from dataclasses import dataclass, fields
 
 
@@ -62,6 +67,9 @@ class Config:
     topk: bool = True            # streaming top-k execution of TopK plans
     build_cache: int = 32        # prepared-build cache capacity (entries)
     metrics: bool = True         # query-scoped metrics (utils/metrics.py)
+    timeline: bool = False       # trace-event timeline (utils/timeline.py)
+    timeline_cap: int = 16384    # timeline ring-buffer capacity (events)
+    log_format: str = "text"     # "text" | "json" (structured log lines)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -78,6 +86,10 @@ class Config:
             topk=_bool_flag("SRJT_TOPK", True),
             build_cache=_int_flag("SRJT_BUILD_CACHE", 32, minimum=1),
             metrics=_bool_flag("SRJT_METRICS", True),
+            timeline=_bool_flag("SRJT_TIMELINE", False),
+            timeline_cap=_int_flag("SRJT_TIMELINE_CAP", 16384, minimum=16),
+            log_format=os.environ.get("SRJT_LOG_FORMAT",
+                                      "text").strip().lower(),
         )
 
 
@@ -98,6 +110,28 @@ def refresh() -> Config:
     return config
 
 
+class _JsonLogFormatter(logging.Formatter):
+    """One JSON object per line: ts/level/logger/msg plus the active query
+    name from the metrics layer when one is bound on the emitting thread —
+    bridge-server log lines correlate with per-query summaries by name."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {"ts": round(record.created, 6),
+               "level": record.levelname,
+               "logger": record.name,
+               "msg": record.getMessage()}
+        try:
+            from . import metrics
+            q = metrics.current()
+            if q is not None:
+                doc["query"] = q.name
+        except Exception:
+            pass
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
 def logger() -> logging.Logger:
     """The package logger (analog of the reference's slf4j-api single dep).
 
@@ -106,9 +140,27 @@ def logger() -> logging.Logger:
     is applied on EVERY call — a host app that configures root logging
     before importing us must not freeze our level at the import-time
     default.
+
+    ``SRJT_LOG_FORMAT=json`` attaches a stderr handler with
+    ``_JsonLogFormatter`` (and stops propagation so lines emit exactly
+    once); switching back to ``text`` detaches it and restores the
+    host-app-owned path.
     """
     log = logging.getLogger("spark_rapids_jni_tpu")
     if not any(isinstance(h, logging.NullHandler) for h in log.handlers):
         log.addHandler(logging.NullHandler())
+    json_handlers = [h for h in log.handlers
+                     if getattr(h, "_srjt_json", False)]
+    if config.log_format == "json":
+        if not json_handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(_JsonLogFormatter())
+            h._srjt_json = True
+            log.addHandler(h)
+        log.propagate = False
+    else:
+        for h in json_handlers:
+            log.removeHandler(h)
+        log.propagate = True
     log.setLevel(config.log_level)
     return log

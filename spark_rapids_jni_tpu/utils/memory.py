@@ -49,6 +49,75 @@ def device_memory_stats(platform: str | None = None) -> dict:
     return {"live_bytes": total, "live_arrays": count}
 
 
+def runtime_memory_stats(platform: str | None = None) -> dict | None:
+    """Allocator-level stats from ``Device.memory_stats()`` where the
+    backend exposes them (TPU/GPU runtimes do, CPU returns None):
+    {bytes_in_use, peak_bytes_in_use} summed across local devices.
+    Returns None when no device reports — callers fall back to the
+    live-array census (the byte-accounting path)."""
+    try:
+        devices = jax.local_devices(backend=platform) if platform \
+            else jax.local_devices()
+    except Exception:
+        return None
+    in_use = peak = 0
+    seen = False
+    for d in devices:
+        ms = getattr(d, "memory_stats", None)
+        try:
+            stats = ms() if callable(ms) else None
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        seen = True
+        b = int(stats.get("bytes_in_use", 0))
+        in_use += b
+        peak += int(stats.get("peak_bytes_in_use", b))
+    if not seen:
+        return None
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+
+def telemetry_snapshot(platform: str | None = None) -> dict:
+    """The per-query device-memory sample the metrics layer records:
+    runtime allocator stats when available (``source: "runtime"``),
+    otherwise the live-array byte census (``source: "census"``).
+    Always carries ``live_bytes``; ``peak_bytes`` only on the runtime
+    path (the census has no allocator high-water to read)."""
+    rt = runtime_memory_stats(platform)
+    if rt is not None:
+        return {"source": "runtime",
+                "live_bytes": rt["bytes_in_use"],
+                "peak_bytes": rt["peak_bytes_in_use"]}
+    c = device_memory_stats(platform)
+    return {"source": "census",
+            "live_bytes": c["live_bytes"],
+            "live_arrays": c["live_arrays"],
+            "peak_bytes": None}
+
+
+def column_nbytes(col) -> int:
+    """Buffer bytes of one column (data + validity + offsets + children).
+
+    Pure metadata reads (``.nbytes`` on device or host arrays) — never
+    forces a transfer or sync, so the executor can account bytes per node
+    on the streaming paths for free."""
+    total = 0
+    for buf in (col.data, col.validity, col.offsets):
+        if buf is not None:
+            total += _array_nbytes(buf)
+    for child in col.children:
+        total += column_nbytes(child)
+    return total
+
+
+def table_nbytes(table) -> int:
+    """Buffer bytes of a Table — the ``bytes_moved`` unit the roofline
+    attribution in ``engine.explain_analyze`` divides by wall time."""
+    return sum(column_nbytes(c) for c in table.columns)
+
+
 @dataclass
 class ScopeStats:
     name: str

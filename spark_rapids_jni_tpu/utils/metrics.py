@@ -40,7 +40,7 @@ import threading
 import time
 from collections import deque
 
-from . import tracing
+from . import timeline, tracing
 from .config import config
 
 # -- registries -------------------------------------------------------------
@@ -90,8 +90,12 @@ def _hist_add(hists: dict, name: str, value: float) -> None:
 
 
 def _hist_dump(h: dict) -> dict:
-    """JSON-friendly histogram copy: buckets as sorted [le, count] pairs."""
+    """JSON-friendly histogram copy: buckets as sorted [le, count] pairs
+    plus ``sum``/``count`` (and the derived ``mean``) so consumers of the
+    OP_METRICS reply compute averages without re-deriving from
+    power-of-two bucket midpoints."""
     return {"count": h["count"], "sum": h["sum"],
+            "mean": (h["sum"] / h["count"]) if h["count"] else None,
             "min": h["min"], "max": h["max"],
             "buckets": sorted([le, n] for le, n in h["buckets"].items())}
 
@@ -105,7 +109,7 @@ def _hist_load(d: dict) -> dict:
 # -- per-query context ------------------------------------------------------
 
 _NODE_FIELDS = ("calls", "wall_s", "rows_in", "rows_out", "chunks",
-                "padded_rows", "host_syncs")
+                "padded_rows", "host_syncs", "bytes_in", "bytes_out")
 
 
 class QueryMetrics:
@@ -119,7 +123,7 @@ class QueryMetrics:
     """
 
     __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
-                 "node_spans", "hists", "timers", "_lock")
+                 "node_spans", "hists", "timers", "mem", "_lock")
 
     def __init__(self, name: str = ""):
         self.qid = next(_qids)
@@ -131,6 +135,7 @@ class QueryMetrics:
         self.node_spans: dict = {}
         self.hists: dict[str, dict] = {}
         self.timers: dict[str, float] = {}
+        self.mem: dict = {}  # device-memory telemetry (mem_sample)
         self._lock = threading.Lock()
 
     def count(self, name: str, n: int = 1) -> None:
@@ -171,6 +176,20 @@ class QueryMetrics:
         if key is not None:
             self.node_add(key, label, host_syncs=n)
 
+    def mem_sample(self, snap: dict) -> None:
+        """Fold one ``memory.telemetry_snapshot`` into the query's
+        device-memory telemetry: last live-bytes + high-water."""
+        live = int(snap.get("live_bytes") or 0)
+        peak = snap.get("peak_bytes")
+        with self._lock:
+            m = self.mem
+            m["source"] = snap.get("source", "census")
+            m["samples"] = m.get("samples", 0) + 1
+            m["live_bytes"] = live
+            hw = max(m.get("high_water_bytes", 0), live,
+                     int(peak) if peak else 0)
+            m["high_water_bytes"] = hw
+
     def note_stats(self, stats: dict) -> None:
         self.stats = dict(stats)
 
@@ -185,15 +204,18 @@ class QueryMetrics:
                 else time.perf_counter() - self.t0
             nodes = [{k: (round(v, 6) if isinstance(v, float) else v)
                       for k, v in r.items()} for r in self.node_spans.values()]
-            return {"qid": self.qid, "name": self.name,
-                    "wall_s": round(wall, 6),
-                    "stats": dict(self.stats),
-                    "counters": dict(self.counters),
-                    "timers": {k: round(v, 6)
-                               for k, v in self.timers.items()},
-                    "histograms": {k: _hist_dump(h)
-                                   for k, h in self.hists.items()},
-                    "nodes": nodes}
+            out = {"qid": self.qid, "name": self.name,
+                   "wall_s": round(wall, 6),
+                   "stats": dict(self.stats),
+                   "counters": dict(self.counters),
+                   "timers": {k: round(v, 6)
+                              for k, v in self.timers.items()},
+                   "histograms": {k: _hist_dump(h)
+                                  for k, h in self.hists.items()},
+                   "nodes": nodes}
+            if self.mem:
+                out["memory"] = dict(self.mem)
+            return out
 
 
 def current() -> QueryMetrics | None:
@@ -297,13 +319,43 @@ def gauge_max(name: str, value: float) -> None:
 
 
 def host_sync(n: int = 1, key=None, label: str = "") -> None:
-    """Record a deliberate device->host sync point (attributed if keyed)."""
+    """Record a deliberate device->host sync point (attributed if keyed).
+
+    Also drops a timeline instant event at the sync site — timeline-gated
+    independently of SRJT_METRICS, so the Perfetto view marks the engine's
+    deliberate syncs even with the metrics layer off."""
+    if config.timeline:
+        timeline.instant("engine.host_sync",
+                         {"label": label} if label else None)
     if not config.metrics:
         return
     tracing.count("engine.host_sync", n)
     q = current()
     if q is not None:
         q.host_sync(n, key=key, label=label)
+
+
+def mem_checkpoint(platform: str | None = None) -> None:
+    """Sample device memory into the active query + process gauges.
+
+    The executor calls this at query boundaries and chunk boundaries of
+    the streaming loops; prefers the runtime allocator's stats (cheap C
+    call on TPU/GPU) and falls back to the live-array byte census.  Pure
+    host-side accounting — no device sync either way."""
+    if not config.metrics:
+        return
+    from . import memory
+    snap = memory.telemetry_snapshot(platform)
+    live = int(snap.get("live_bytes") or 0)
+    gauge_set("memory.device.live_bytes", live)
+    peak = snap.get("peak_bytes")
+    gauge_max("memory.device.high_water_bytes",
+              int(peak) if peak else live)
+    if config.timeline:
+        timeline.counter("memory.device.live_bytes", live)
+    q = current()
+    if q is not None:
+        q.mem_sample(snap)
 
 
 # -- snapshots / test isolation ---------------------------------------------

@@ -20,20 +20,26 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import threading
 
 import jax
 
+from . import timeline
 from .config import config
 
 
 @contextlib.contextmanager
 def op_scope(name: str):
-    """Named scope + (when SRJT_TRACE=1) a host profiler annotation."""
+    """Named scope + (when SRJT_TRACE=1) a host profiler annotation +
+    (when SRJT_TIMELINE=1) a span in the in-process event timeline —
+    one call site, three observability sinks on the same name."""
     with contextlib.ExitStack() as stack:
         stack.enter_context(jax.named_scope(name))
         if config.trace:
             stack.enter_context(jax.profiler.TraceAnnotation(name))
+        if config.timeline:
+            stack.enter_context(timeline.span(name))
         yield
 
 
@@ -48,6 +54,7 @@ def traced(name: str):
     return wrap
 
 
+@contextlib.contextmanager
 def profile(logdir: str):
     """Device+host trace capture; view in Perfetto/TensorBoard.
 
@@ -55,8 +62,27 @@ def profile(logdir: str):
 
         with tracing.profile("/tmp/trace"):
             run_query(...)
+
+    Creates ``logdir`` if missing, and degrades to a warning no-op when
+    ``jax.profiler`` is unavailable or fails to start on this platform —
+    the docs/OBSERVABILITY.md recipe must work on a clean checkout, not
+    raise (the SRJT_TIMELINE path exists for exactly those shells).
     """
-    return jax.profiler.trace(logdir)
+    from .config import logger
+    os.makedirs(logdir, exist_ok=True)
+    try:
+        cm = jax.profiler.trace(logdir)
+        cm.__enter__()
+    except Exception as e:
+        logger().warning(
+            "jax.profiler unavailable (%s); profile(%r) is a no-op — "
+            "use SRJT_TIMELINE=1 for the in-process timeline", e, logdir)
+        yield
+        return
+    try:
+        yield
+    finally:
+        cm.__exit__(None, None, None)
 
 
 # -- named event counters --------------------------------------------------

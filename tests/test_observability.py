@@ -5,6 +5,7 @@ Reference analogs: nvtx ranges toggled by ``ai.rapids.cudf.nvtx.enabled``
 leak tracking sysprop (pom.xml:85,406), slf4j logging.
 """
 
+import json
 import os
 
 import numpy as np
@@ -326,3 +327,243 @@ def test_logger_null_handler_and_live_level(monkeypatch):
     monkeypatch.delenv("SRJT_LOG_LEVEL")
     cfg.refresh()
     assert cfg.logger().level == logging.WARNING
+
+
+# ---------------------------------------------------------------------------
+# PR 6: timeline-era observability — histogram export completeness, device
+# telemetry, roofline attribution, JSON logging, profile() hardening, and
+# the bench regression gate
+
+
+def test_histogram_snapshot_exports_sum_count_mean(metrics_isolation):
+    """Snapshots must carry sum/count (and the derived mean) alongside the
+    buckets — without them a scraper can't compute averages."""
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics_isolation("test.hist")
+    for v in (1.0, 2.0, 6.0):
+        metrics.observe("test.hist.lat", v)
+    h = metrics.histograms_snapshot("test.hist")["test.hist.lat"]
+    assert h["count"] == 3
+    assert h["sum"] == 9.0
+    assert h["mean"] == pytest.approx(3.0)
+    assert h["min"] == 1.0 and h["max"] == 6.0
+    assert h["buckets"]  # the [le, count] pairs are still there
+
+
+def test_explain_analyze_roofline_columns(metrics_warehouse, monkeypatch):
+    """Per-node cost attribution: bytes_moved / GB/s / roofline_frac in
+    both the structured nodes and the rendered tree, against the env-pinned
+    ceiling (SRJT_ROOFLINE_GBPS wins over BENCH_BASELINES.json)."""
+    from spark_rapids_jni_tpu.engine import explain_analyze
+    monkeypatch.setenv("SRJT_ROOFLINE_GBPS", "100.0")
+    rep = explain_analyze(_agg_plan(metrics_warehouse), fused=True)
+    root = rep.nodes[-1]["metrics"]
+    assert root["bytes_moved"] > 0
+    assert root["GBps"] is not None and root["GBps"] > 0
+    # GBps is rounded to 3 decimals but roofline_frac is computed from the
+    # unrounded rate, so compare within the rounding quantum (5e-4 / 100)
+    assert root["roofline_frac"] == pytest.approx(root["GBps"] / 100.0,
+                                                  abs=6e-6)
+    assert "bytes_moved=" in rep.text
+    assert "GB/s=" in rep.text
+    assert "roofline_frac=" in rep.text
+    assert "roofline_ceiling_GBps=100.0" in rep.text
+    # conservation: the scan's bytes_out feed downstream bytes_in, so the
+    # plan's total moved bytes must exceed the raw decoded column bytes
+    total = sum(n["metrics"]["bytes_moved"] for n in rep.nodes
+                if n["metrics"] is not None)
+    assert total >= root["bytes_moved"]
+
+
+def test_roofline_ceiling_from_baselines_file():
+    """Without the env override the ceiling comes from the
+    device_bandwidth_ceiling_GBps pin in BENCH_BASELINES.json."""
+    from spark_rapids_jni_tpu.engine import explain as ex
+    assert "SRJT_ROOFLINE_GBPS" not in os.environ
+    ex._ceiling_cache[0] = False  # force a re-read
+    ceiling = ex.roofline_ceiling_gbps()
+    assert ceiling == pytest.approx(562.11)
+
+
+def test_memory_telemetry_in_summary_and_gauges(metrics_warehouse,
+                                                metrics_isolation):
+    """mem_checkpoint() during a streamed query lands device-memory gauges
+    in the flat registry AND a memory block in the query summary (and the
+    EXPLAIN ANALYZE footer)."""
+    from spark_rapids_jni_tpu.engine import explain_analyze
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics_isolation("memory.device")
+    rep = explain_analyze(_agg_plan(metrics_warehouse), fused=True)
+    mem = rep.summary.get("memory")
+    assert mem, "streamed query recorded no memory telemetry"
+    assert mem["source"] in ("runtime", "census")
+    assert mem["samples"] >= 1
+    assert mem["high_water_bytes"] >= mem["live_bytes"] >= 0
+    assert mem["high_water_bytes"] > 0
+    g = metrics.gauges_snapshot("memory.device")
+    assert g["memory.device.live_bytes"] >= 0
+    assert g["memory.device.high_water_bytes"] > 0
+    assert "-- memory" in rep.text
+
+
+def test_telemetry_snapshot_and_nbytes():
+    """telemetry_snapshot() always answers (census fallback on CPU), and
+    table_nbytes sums exactly the buffers a Table holds — metadata reads
+    only, no device sync."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.utils import memory
+    snap = memory.telemetry_snapshot()
+    assert snap["source"] in ("runtime", "census")
+    assert snap["live_bytes"] >= 0
+    t = Table([Column.from_numpy(np.arange(100, dtype=np.int64)),
+               Column.from_numpy(np.arange(100, dtype=np.float64))],
+              ["a", "b"])
+    nb = memory.table_nbytes(t)
+    assert nb == sum(memory.column_nbytes(c) for c in t.columns)
+    assert nb >= 2 * 100 * 8
+
+
+def test_json_log_format(monkeypatch, capsys):
+    """SRJT_LOG_FORMAT=json: one JSON object per line on stderr carrying
+    ts/level/logger/msg and the bound query name; switching back to text
+    detaches the handler and restores propagation."""
+    import logging
+    from spark_rapids_jni_tpu.utils import metrics
+    monkeypatch.setenv("SRJT_LOG_FORMAT", "json")
+    cfg.refresh()
+    try:
+        log = cfg.logger()
+        assert log.propagate is False
+        jh = [h for h in log.handlers if getattr(h, "_srjt_json", False)]
+        assert len(jh) == 1
+        rec = logging.LogRecord("spark_rapids_jni_tpu", logging.WARNING,
+                                __file__, 1, "hello %s", ("world",), None)
+        doc = json.loads(jh[0].format(rec))
+        assert doc["level"] == "WARNING"
+        assert doc["logger"] == "spark_rapids_jni_tpu"
+        assert doc["msg"] == "hello world"
+        assert isinstance(doc["ts"], float)
+        assert "query" not in doc
+        with metrics.query("jq"):
+            doc = json.loads(jh[0].format(rec))
+            assert doc["query"] == "jq"
+        log.warning("through the handler")
+        assert '"msg": "through the handler"' in capsys.readouterr().err
+    finally:
+        monkeypatch.delenv("SRJT_LOG_FORMAT")
+        cfg.refresh()
+    log = cfg.logger()
+    assert log.propagate is True
+    assert not [h for h in log.handlers if getattr(h, "_srjt_json", False)]
+
+
+def test_profile_noop_without_jax_profiler(monkeypatch, tmp_path):
+    """profile() must create the logdir and degrade to a warned no-op when
+    jax.profiler can't start (headless shells, unsupported backends)."""
+    import jax
+
+    def boom(logdir):
+        raise RuntimeError("no profiler here")
+
+    monkeypatch.setattr(jax.profiler, "trace", boom)
+    logdir = tmp_path / "prof" / "run1"
+    ran = False
+    with tracing.profile(str(logdir)):
+        ran = True
+    assert ran
+    assert logdir.is_dir()  # created even though tracing never started
+
+
+def test_profile_enters_and_exits_jax_trace(monkeypatch, tmp_path):
+    import jax
+    calls = []
+
+    class FakeTrace:
+        def __init__(self, logdir):
+            calls.append(("init", logdir))
+
+        def __enter__(self):
+            calls.append(("enter",))
+
+        def __exit__(self, *exc):
+            calls.append(("exit",))
+
+    monkeypatch.setattr(jax.profiler, "trace", FakeTrace)
+    with tracing.profile(str(tmp_path / "d")):
+        calls.append(("body",))
+    assert [c[0] for c in calls] == ["init", "enter", "body", "exit"]
+
+
+# -- ci/bench_gate.py --------------------------------------------------------
+
+def _load_bench_gate():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ci", "bench_gate.py")
+    spec = importlib.util.spec_from_file_location("bench_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_gate_classification(tmp_path):
+    """Flattening, direction handling, and the four statuses."""
+    bg = _load_bench_gate()
+    baselines = tmp_path / "pins.json"
+    baselines.write_text(json.dumps({"_gate": {
+        "tolerance_default": 0.2,
+        "metrics": {
+            "m.value": {"reference": 100.0, "direction": "higher"},
+            "m.extras.sub.value": {"reference": 10.0, "direction": "higher",
+                                   "tolerance": 0.5},
+            "lat.latency_ms.p50": {"reference": 50.0, "direction": "lower"},
+            "gone.value": {"reference": 1.0, "direction": "higher"},
+        }}}))
+    artifact = "\n".join([
+        "non-json chatter is skipped",
+        json.dumps({"metric": "m", "value": 90.0, "ok": True,
+                    "extras": {"sub": {"value": 30.0}}}),
+        json.dumps({"metric": "lat", "latency_ms": {"p50": 70.0}}),
+    ])
+    s = bg.run_gate(artifact, str(baselines))
+    rows = s["rows"]
+    assert rows["m.value"]["status"] == "ok"          # within 20%
+    assert rows["m.extras.sub.value"]["status"] == "improved"
+    assert rows["lat.latency_ms.p50"]["status"] == "regression"  # lower-is-better
+    assert rows["gone.value"]["status"] == "missing"
+    assert (s["checked"], s["ok"], s["improved"],
+            s["regressions"], s["missing"]) == (4, 1, 1, 1, 1)
+    text = bg.render(s)
+    assert "regression" in text and "gone.value" in text
+
+
+def test_bench_gate_exit_codes(tmp_path, capsys):
+    """Report-only always exits 0; --enforce fails on regressions."""
+    bg = _load_bench_gate()
+    baselines = tmp_path / "pins.json"
+    baselines.write_text(json.dumps({"_gate": {
+        "tolerance_default": 0.25,
+        "metrics": {"m.value": {"reference": 100.0,
+                                "direction": "higher"}}}}))
+    art = tmp_path / "bench.json"
+    art.write_text(json.dumps({"metric": "m", "value": 10.0}))
+    assert bg.main(["--artifact", str(art),
+                    "--baselines", str(baselines)]) == 0
+    assert bg.main(["--artifact", str(art), "--baselines", str(baselines),
+                    "--enforce"]) == 1
+    art.write_text(json.dumps({"metric": "m", "value": 99.0}))
+    assert bg.main(["--artifact", str(art), "--baselines", str(baselines),
+                    "--enforce"]) == 0
+    out = capsys.readouterr().out
+    assert '"metric": "bench_gate"' in out
+
+
+def test_bench_gate_repo_artifacts_parse():
+    """The real BENCH_BASELINES.json _gate section loads, and every gated
+    full-bench key matches the artifact shape bench.py main() emits."""
+    bg = _load_bench_gate()
+    specs, tol = bg.load_gate(bg.DEFAULT_BASELINES)
+    assert specs and 0 < tol < 1
+    for key, spec in specs.items():
+        assert spec["direction"] in ("higher", "lower")
+        assert float(spec["reference"]) > 0

@@ -1,0 +1,237 @@
+"""SRJT_TIMELINE: in-process Chrome trace-event timeline (utils/timeline.py).
+
+The jax.profiler-free observability layer: bounded ring buffer of spans /
+instants / flows / counters exported as trace-event JSON that Perfetto can
+load directly.  These tests pin the three contracts the module makes:
+
+- the export is VALID Chrome trace-event JSON (schema-checked, not just
+  ``json.loads``-able);
+- concurrent threads record disjoint, well-nested span sets attributed to
+  their own query contexts;
+- ring overflow drops the OLDEST finished events and can never corrupt a
+  still-open span (open spans hold no buffer slot by construction).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.utils import config as cfg
+from spark_rapids_jni_tpu.utils import metrics, timeline
+
+# every ph code the module may emit; X carries dur, M is metadata
+_PH_ALLOWED = {"X", "i", "C", "s", "f", "M"}
+
+
+@pytest.fixture
+def timeline_on(monkeypatch):
+    """SRJT_TIMELINE=1 with a clean buffer, restored on exit."""
+    monkeypatch.setenv("SRJT_TIMELINE", "1")
+    cfg.refresh()
+    timeline.reset()
+    yield
+    monkeypatch.delenv("SRJT_TIMELINE")
+    cfg.refresh()
+    timeline.reset()
+
+
+def _check_trace_schema(doc):
+    """Assert ``doc`` is a loadable Chrome trace-event document."""
+    assert set(doc) >= {"traceEvents"}
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert {"name", "ph", "pid"} <= set(e), e
+        assert e["ph"] in _PH_ALLOWED, e
+        if e["ph"] == "M":
+            continue
+        assert isinstance(e["ts"], (int, float)), e
+        assert "tid" in e, e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0, e
+        if e["ph"] in ("s", "f"):
+            assert "id" in e, e
+    # at least the process_name metadata record must be present
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in evs)
+
+
+def test_disabled_records_nothing():
+    """Default SRJT_TIMELINE=0: spans/instants/counters are no-ops."""
+    assert not timeline.enabled()
+    timeline.reset()
+    with timeline.span("off.region"):
+        timeline.instant("off.mark")
+        timeline.counter("off.gauge", 1.0)
+    timeline.flow_start("off.flow", 1)
+    timeline.flow_finish("off.flow", 1)
+    assert timeline.events_snapshot() == []
+
+
+def test_export_is_valid_chrome_trace(timeline_on, tmp_path):
+    with timeline.span("outer", {"k": 1}):
+        with timeline.span("inner"):
+            timeline.instant("mark")
+        timeline.counter("bytes", 42.0)
+    fid = timeline.new_flow_base()
+    timeline.flow_start("hand", fid)
+    timeline.flow_finish("hand", fid)
+
+    path = timeline.dump(str(tmp_path / "sub" / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)   # byte-for-byte what a trace viewer loads
+    _check_trace_schema(doc)
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert {"outer", "inner", "mark", "bytes", "hand"} <= set(names)
+    # spans are well-nested: inner lies within [outer.ts, outer.ts+dur]
+    by = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert by["outer"]["ts"] <= by["inner"]["ts"]
+    assert (by["inner"]["ts"] + by["inner"]["dur"]
+            <= by["outer"]["ts"] + by["outer"]["dur"] + 1e-6)
+
+
+def test_two_threads_disjoint_well_nested(timeline_on):
+    """Two helper threads, each bound to its own query context, produce
+    per-tid event sets that are disjoint, well-nested, and attributed to
+    the right query name."""
+    qa = metrics.QueryMetrics("qa")
+    qb = metrics.QueryMetrics("qb")
+    barrier = threading.Barrier(2)
+
+    def body(qm, label):
+        with metrics.bind(qm):
+            barrier.wait()
+            for i in range(3):
+                with timeline.span(f"{label}.outer"):
+                    with timeline.span(f"{label}.inner", {"i": i}):
+                        pass
+
+    ta = threading.Thread(target=body, args=(qa, "a"), name="worker-a")
+    tb = threading.Thread(target=body, args=(qb, "b"), name="worker-b")
+    ta.start(); tb.start(); ta.join(); tb.join()
+
+    evs = timeline.events_snapshot()
+    tids = {e["tid"] for e in evs}
+    assert len(tids) == 2
+    for tid in tids:
+        mine = [e for e in evs if e["tid"] == tid]
+        labels = {e["name"].split(".")[0] for e in mine}
+        assert len(labels) == 1          # disjoint: no cross-thread events
+        label = labels.pop()
+        want_q = {"a": "qa", "b": "qb"}[label]
+        assert all(e["args"]["query"] == want_q for e in mine)
+        # well-nested: events append at span CLOSE, so each inner X must
+        # land within the immediately following outer X on the same thread
+        inners = [e for e in mine if e["name"].endswith(".inner")]
+        outers = [e for e in mine if e["name"].endswith(".outer")]
+        assert len(inners) == len(outers) == 3
+        for i, o in zip(inners, outers):
+            assert o["ts"] <= i["ts"]
+            assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-6
+    # thread names land in the export metadata
+    meta = {e["tid"]: e["args"]["name"]
+            for e in timeline.export()["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"worker-a", "worker-b"} <= set(meta.values())
+
+
+def test_ring_overflow_drops_oldest_keeps_open_span(timeline_on,
+                                                    monkeypatch):
+    """At SRJT_TIMELINE_CAP the deque drops the OLDEST events; a span open
+    across the overflow closes intact (it holds no slot while open)."""
+    monkeypatch.setenv("SRJT_TIMELINE_CAP", "16")
+    cfg.refresh()
+    timeline.reset()
+    with timeline.span("survivor"):
+        for i in range(40):
+            timeline.instant(f"tick.{i}")
+    evs = timeline.events_snapshot()
+    assert len(evs) == 16                      # cap respected
+    names = [e["name"] for e in evs]
+    assert names[-1] == "survivor"             # closed after the ticks
+    # the newest 15 ticks survive, the oldest 25 were dropped
+    assert names[:-1] == [f"tick.{i}" for i in range(25, 40)]
+    ev = evs[-1]
+    assert ev["ph"] == "X" and ev["dur"] >= 0  # not corrupted by overflow
+
+
+def test_cap_shrink_keeps_newest_tail(timeline_on, monkeypatch):
+    for i in range(8):
+        timeline.instant(f"e{i}")
+    monkeypatch.setenv("SRJT_TIMELINE_CAP", "16")  # min clamp is 16
+    cfg.refresh()
+    for i in range(8, 20):
+        timeline.instant(f"e{i}")
+    names = [e["name"] for e in timeline.events_snapshot()]
+    assert len(names) == 16
+    assert names == [f"e{i}" for i in range(4, 20)]
+
+
+def test_engine_query_emits_sync_instants_and_flows(timeline_on, tmp_path):
+    """A streamed+prefetched aggregate records host-sync instants and
+    producer->consumer flow arrows whose ids match across two threads."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.engine import (Aggregate, Scan, optimize)
+    from spark_rapids_jni_tpu.engine.executor import execute, new_stats
+
+    rng = np.random.default_rng(11)
+    n = 4_000
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+        "v": pa.array(np.round(rng.uniform(-5.0, 50.0, n), 3)),
+    }), tmp_path / "fact.parquet", row_group_size=500)
+
+    plan = optimize(Aggregate(
+        Scan(str(tmp_path / "fact.parquet"), chunk_bytes=12_000),
+        ["k"], [("v", "sum")], names=["s"]))
+    stats = new_stats()
+    with metrics.query("tl-flow"):
+        execute(plan, stats, fused=True, prefetch=2)
+    assert stats["chunks"] > 1
+
+    evs = timeline.events_snapshot()
+    assert any(e["name"] == "engine.host_sync" and e["ph"] == "i"
+               for e in evs)
+    starts = {e["id"]: e for e in evs
+              if e["ph"] == "s" and e["name"] == "io.parquet.chunk"}
+    finishes = {e["id"]: e for e in evs
+                if e["ph"] == "f" and e["name"] == "io.parquet.chunk"}
+    linked = set(starts) & set(finishes)
+    assert linked                               # producer met consumer
+    assert all(starts[i]["tid"] != finishes[i]["tid"] for i in linked)
+    assert all(starts[i]["ts"] <= finishes[i]["ts"] for i in linked)
+    # engine node spans came through op_scope for free
+    span_names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert any(s.startswith("engine.") for s in span_names)
+    _check_trace_schema(timeline.export())
+
+
+def test_timeline_off_leaves_streaming_paths_clean(tmp_path, monkeypatch):
+    """SRJT_TIMELINE=0 + SRJT_METRICS=0: the same streamed query runs with
+    an empty timeline buffer — the uninstrumented fast path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.engine import Aggregate, Scan, optimize
+    from spark_rapids_jni_tpu.engine.executor import execute, new_stats
+
+    monkeypatch.setenv("SRJT_METRICS", "0")
+    cfg.refresh()
+    timeline.reset()
+    try:
+        rng = np.random.default_rng(12)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 8, 2_000).astype(np.int64)),
+            "v": pa.array(rng.uniform(0.0, 1.0, 2_000)),
+        }), tmp_path / "f.parquet", row_group_size=500)
+        plan = optimize(Aggregate(
+            Scan(str(tmp_path / "f.parquet"), chunk_bytes=12_000),
+            ["k"], [("v", "sum")], names=["s"]))
+        execute(plan, new_stats(), fused=True, prefetch=2)
+        assert timeline.events_snapshot() == []
+    finally:
+        monkeypatch.delenv("SRJT_METRICS")
+        cfg.refresh()
