@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 
 ci/premerge.sh
 
+# nightly lint: premerge covers the smoke plans; --full extends the jaxpr
+# sync-lint over the bench join + top-k plan shapes
+JAX_PLATFORMS=cpu python tools/srjt_lint.py --segments --full \
+    --baseline ci/lint-baseline.json
+
 # benchmarks (runs on whatever backend jax selects; TPU when present)
 python bench.py | tee target/bench-nightly.json
 

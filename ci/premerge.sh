@@ -17,6 +17,14 @@ cmake -S src/main/cpp -B target/cpp-build -G Ninja \
       -DCMAKE_BUILD_TYPE=Release
 cmake --build target/cpp-build
 
+# static analysis (docs/ANALYSIS.md): repo AST lint (traced-host-op,
+# config-env-read, host-sync-site), dispatch-table exhaustiveness, and the
+# jaxpr sync-lint over the smoke plans' fused segments — exactly the 3
+# whitelisted host syncs, no host callbacks, static output shapes.  New
+# violations (anything not in ci/lint-baseline.json) fail the gate.
+JAX_PLATFORMS=cpu python tools/srjt_lint.py --segments \
+    --baseline ci/lint-baseline.json
+
 # full suite on the virtual 8-device CPU mesh (includes bridge round trip)
 python -m pytest tests/ -q
 

@@ -64,6 +64,25 @@ import itertools
 _IMP_COUNTER = itertools.count(1)
 
 
+def _bridge_error(body: bytes) -> Exception:
+    """Exception for a STATUS_ERROR reply.
+
+    Structured plan-verification replies (JSON with ``error:
+    plan_verification``) reconstruct the server-side
+    ``PlanVerificationError`` — code and node path intact, so callers can
+    dispatch on ``e.code`` — everything else stays the flat RuntimeError."""
+    if body[:1] == b"{":
+        try:
+            import json
+            doc = json.loads(body.decode())
+        except Exception:
+            doc = None
+        if isinstance(doc, dict) and doc.get("error") == "plan_verification":
+            from ..engine.verify import PlanVerificationError
+            return PlanVerificationError.from_dict(doc)
+    return RuntimeError(f"bridge error: {body.decode()}")
+
+
 class BridgeClient:
     def __init__(self, sock_path: str):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -78,7 +97,7 @@ class BridgeClient:
         P.send_msg(self.sock, opcode, payload)
         status, body = P.recv_msg(self.sock)
         if status != P.STATUS_OK:
-            raise RuntimeError(f"bridge error: {body.decode()}")
+            raise _bridge_error(body)
         return body
 
     def ping(self) -> None:

@@ -36,6 +36,21 @@ _COLDESC = P.COLDESC
 _STRDESC = P.STRDESC
 
 
+def _error_body(e: Exception) -> bytes:
+    """STATUS_ERROR payload for one failed op.
+
+    Plan-verification failures ship as a JSON document carrying the check
+    code + node path (the client reconstructs a ``PlanVerificationError``);
+    everything else keeps the plain ``Type: message`` text the error
+    discipline has always used."""
+    from ..engine.verify import PlanVerificationError
+    if isinstance(e, PlanVerificationError):
+        import json
+        return json.dumps({"error": "plan_verification",
+                           **e.to_dict()}).encode()
+    return f"{type(e).__name__}: {e}".encode()
+
+
 class HandleTable:
     """u64 id -> device object; the process-local analog of JNI jlong handles."""
 
@@ -407,6 +422,14 @@ class BridgeServer:
         blob = payload[4:4 + plen]
         from ..engine import deserialize
         plan = deserialize(blob)
+        from ..utils.config import config
+        if config.verify:
+            # build-time checks up front: a bad plan (unknown column, join
+            # dtype mismatch, ...) becomes a structured error reply carrying
+            # the check code + node path (_error_body), not an executor
+            # traceback from deep inside a chunk loop
+            from ..engine import verify
+            verify(plan)
         if self._plan_cache is None:
             from ..engine import PlanCache
             self._plan_cache = PlanCache()
@@ -584,8 +607,7 @@ class BridgeServer:
                     self._metrics["errors"] += 1
                     self._log.warning("op %d failed: %s: %s", opcode,
                                       type(e).__name__, e)
-                    status, resp = (P.STATUS_ERROR,
-                                    f"{type(e).__name__}: {e}".encode())
+                    status, resp = P.STATUS_ERROR, _error_body(e)
                 else:
                     status, resp = P.STATUS_OK, out
                 try:

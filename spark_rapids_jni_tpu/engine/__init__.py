@@ -34,8 +34,14 @@ from .plan import (  # noqa: F401
     expr_columns,
     from_dict,
     lit,
+    node_label,
 )
 from .optimizer import optimize, output_names  # noqa: F401
+from .verify import (  # noqa: F401
+    PlanVerificationError,
+    SchemaResolver,
+    verify,
+)
 from .executor import execute, new_stats  # noqa: F401
 from .cache import (  # noqa: F401
     BUILD_CACHE,

@@ -27,7 +27,7 @@ from ..utils import metrics
 from ..utils.memory import table_nbytes
 from ..utils.tracing import op_scope
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, TopK)
+                   Sort, TopK, node_label)
 
 #: aggregate ops with a (merge-op) decomposition usable for per-chunk
 #: partials; value = op that combines partial results
@@ -249,7 +249,7 @@ def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx,
         # the chain collapses into one program, so the segment root's
         # rows_in/bytes_in is the breaker-boundary input (unless the input
         # IS the direct child, which the _exec wrapper counts from memo)
-        qm.node_add(id(node), type(node).__name__.lower(),
+        qm.node_add(id(node), node_label(node),
                     rows_in=inp.num_rows, bytes_in=table_nbytes(inp))
     if not sg.runtime_eligible(seg, inp):
         return _interp_chain(seg, inp, stats)
@@ -261,64 +261,77 @@ def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx,
         return sg.run_map_segment(compiled, inp)
 
 
+def _exec_scan(node: Scan, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
+    return _scan_table(node, stats)
+
+
+def _exec_filter(node: Filter, memo: dict, stats: dict,
+                 ctx: _ExecCtx) -> Table:
+    seg = ctx.segment_for(node)
+    if seg is not None:
+        return _exec_segment(seg, memo, stats, ctx, node)
+    return _filter_table(_exec(node.child, memo, stats, ctx),
+                         node.predicate)
+
+
+def _exec_project(node: Project, memo: dict, stats: dict,
+                  ctx: _ExecCtx) -> Table:
+    seg = ctx.segment_for(node)
+    if seg is not None:
+        return _exec_segment(seg, memo, stats, ctx, node)
+    return _exec(node.child, memo, stats, ctx).select(list(node.columns))
+
+
+def _exec_join(node: Join, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
+    left = _exec(node.left, memo, stats, ctx)
+    right = _exec(node.right, memo, stats, ctx)
+    return _join_fns()[node.how](left, right, list(node.left_keys),
+                                 list(node.right_keys))
+
+
+def _exec_aggregate(node: Aggregate, memo: dict, stats: dict,
+                    ctx: _ExecCtx) -> Table:
+    scan = _stream_scan_of(node)
+    if scan is not None:
+        return _exec_streamed(node, scan, memo, stats, ctx)
+    seg = ctx.segment_for(node)
+    if seg is not None:
+        return _exec_segment(seg, memo, stats, ctx, node)
+    return _groupby(_exec(node.child, memo, stats, ctx), node)
+
+
+def _exec_sort(node: Sort, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
+    from ..ops.order import SortKey
+    from ..ops.selection import sort_table
+    t = _exec(node.child, memo, stats, ctx)
+    return sort_table(t, [SortKey(t[c], ascending=a) for c, a in node.keys])
+
+
+def _exec_limit(node: Limit, memo: dict, stats: dict,
+                ctx: _ExecCtx) -> Table:
+    from ..ops.selection import slice_table
+    t = _exec(node.child, memo, stats, ctx)
+    return slice_table(t, 0, min(node.n, t.num_rows))
+
+
 def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     if id(node) in memo:
         return memo[id(node)]
+    handler = _EXEC_DISPATCH.get(type(node))
+    if handler is None:
+        raise TypeError(f"unknown plan node {type(node).__name__} "
+                        f"(register it in executor._EXEC_DISPATCH)")
     stats["nodes"] += 1
     qm = metrics.current()
     t0 = time.perf_counter() if qm is not None else 0.0
-    with op_scope(f"engine.{type(node).__name__.lower()}"):
-        if isinstance(node, Scan):
-            out = _scan_table(node, stats)
-        elif isinstance(node, Filter):
-            seg = ctx.segment_for(node)
-            if seg is not None:
-                out = _exec_segment(seg, memo, stats, ctx, node)
-            else:
-                out = _filter_table(_exec(node.child, memo, stats, ctx),
-                                    node.predicate)
-        elif isinstance(node, Project):
-            seg = ctx.segment_for(node)
-            if seg is not None:
-                out = _exec_segment(seg, memo, stats, ctx, node)
-            else:
-                out = _exec(node.child, memo, stats,
-                            ctx).select(list(node.columns))
-        elif isinstance(node, Join):
-            left = _exec(node.left, memo, stats, ctx)
-            right = _exec(node.right, memo, stats, ctx)
-            out = _join_fns()[node.how](left, right, list(node.left_keys),
-                                        list(node.right_keys))
-        elif isinstance(node, Aggregate):
-            scan = _stream_scan_of(node)
-            if scan is not None:
-                out = _exec_streamed(node, scan, memo, stats, ctx)
-            else:
-                seg = ctx.segment_for(node)
-                if seg is not None:
-                    out = _exec_segment(seg, memo, stats, ctx, node)
-                else:
-                    out = _groupby(_exec(node.child, memo, stats, ctx), node)
-        elif isinstance(node, Sort):
-            from ..ops.order import SortKey
-            from ..ops.selection import sort_table
-            t = _exec(node.child, memo, stats, ctx)
-            out = sort_table(t, [SortKey(t[c], ascending=a)
-                                 for c, a in node.keys])
-        elif isinstance(node, Limit):
-            from ..ops.selection import slice_table
-            t = _exec(node.child, memo, stats, ctx)
-            out = slice_table(t, 0, min(node.n, t.num_rows))
-        elif isinstance(node, TopK):
-            out = _exec_topk(node, memo, stats, ctx)
-        else:
-            raise TypeError(f"unknown plan node {type(node).__name__}")
+    with op_scope(f"engine.{node_label(node)}"):
+        out = handler(node, memo, stats, ctx)
     if qm is not None:
         # rows_in/bytes_in from the memoized children: on the streamed
         # path the per-chunk re-walk resolves the scan from the chunk
         # overlay, so the accumulated totals ARE the per-chunk flow.
         # bytes are buffer-metadata sums (.nbytes) — no sync.
-        qm.node_add(id(node), type(node).__name__.lower(),
+        qm.node_add(id(node), node_label(node),
                     calls=1, wall_s=time.perf_counter() - t0,
                     rows_out=out.num_rows,
                     bytes_out=table_nbytes(out),
@@ -450,7 +463,7 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                         # per-chunk latency is dispatch time — the fused
                         # loop never syncs per chunk, by design
                         dt = time.perf_counter() - tc0
-                        qm.node_add(id(agg), "aggregate", chunks=1,
+                        qm.node_add(id(agg), node_label(agg), chunks=1,
                                     rows_in=int(nvalid),
                                     bytes_in=table_nbytes(chunk),
                                     padded_rows=int(chunk.num_rows - nvalid))
@@ -524,7 +537,7 @@ def _stream_partial(agg: Aggregate, scan: Scan, chunk: Table, memo: dict,
     t = _exec(agg.child, sub, stats, ctx)
     out = [_groupby(t, agg)] if t.num_rows else []
     if qm is not None:
-        qm.node_add(id(agg), "aggregate", chunks=1,
+        qm.node_add(id(agg), node_label(agg), chunks=1,
                     rows_in=chunk.num_rows,
                     bytes_in=table_nbytes(chunk))
         metrics.observe("engine.stream.chunk_latency_s",
@@ -579,7 +592,7 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
             stats["chunks"] += 1
             tc0 = time.perf_counter() if qm is not None else 0.0
             if qm is not None:
-                qm.node_add(id(node), "topk", chunks=1,
+                qm.node_add(id(node), node_label(node), chunks=1,
                             rows_in=chunk.num_rows,
                             bytes_in=table_nbytes(chunk))
             sub = _ChunkMemo(memo)
@@ -625,6 +638,20 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     return buf
 
 
+#: plan-node class -> handler; the verifier's exhaustiveness lint
+#: (tools/srjt_lint.py) asserts every plan._NODE_TYPES class is here
+_EXEC_DISPATCH = {
+    Scan: _exec_scan,
+    Filter: _exec_filter,
+    Project: _exec_project,
+    Join: _exec_join,
+    Aggregate: _exec_aggregate,
+    Sort: _exec_sort,
+    Limit: _exec_limit,
+    TopK: _exec_topk,
+}
+
+
 def execute(plan: PlanNode, stats: Optional[dict] = None,
             fused: Optional[bool] = None,
             prefetch: Optional[int] = None) -> Table:
@@ -651,8 +678,7 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
                    else int(prefetch))
     # one QueryMetrics per top-level execute (nested/re-entrant executes
     # attribute into the enclosing query); SRJT_METRICS=0 skips entirely
-    with metrics.maybe_query(
-            f"execute:{type(plan).__name__.lower()}") as qm:
+    with metrics.maybe_query(f"execute:{node_label(plan)}") as qm:
         out = _exec(plan, {}, stats, ctx)
         if qm is not None:
             qm.note_stats(stats)

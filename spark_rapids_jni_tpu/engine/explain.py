@@ -24,7 +24,7 @@ from typing import Optional
 from ..columnar import Table
 from ..utils import metrics
 from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, TopK)
+                   Sort, TopK, node_label)
 
 # -- roofline ceiling --------------------------------------------------------
 
@@ -61,34 +61,37 @@ def roofline_ceiling_gbps() -> Optional[float]:
     return _ceiling_cache[1]
 
 
+def _describe_scan(node: Scan) -> str:
+    bits = [repr(node.path)]
+    if node.columns:
+        bits.append(f"columns={list(node.columns)}")
+    if node.predicate is not None:
+        bits.append(f"predicate={node.predicate}")
+    if node.chunk_bytes:
+        bits.append(f"chunk_bytes={node.chunk_bytes}")
+    return f"Scan({', '.join(bits)})"
+
+
+#: plan-node class -> one-line logical description (the EXPLAIN half);
+#: the exhaustiveness lint (tools/srjt_lint.py) asserts every
+#: plan._NODE_TYPES class is here
+_DESCRIBE = {
+    Scan: _describe_scan,
+    Filter: lambda n: f"Filter({n.predicate})",
+    Project: lambda n: f"Project({list(n.columns)})",
+    Join: lambda n: (f"Join(how={n.how!r}, {list(n.left_keys)} = "
+                     f"{list(n.right_keys)})"),
+    Aggregate: lambda n: (f"Aggregate(keys={list(n.keys)}, "
+                          f"aggs={[(c, op) for c, op in n.aggs]})"),
+    Sort: lambda n: f"Sort({list(n.keys)})",
+    Limit: lambda n: f"Limit({n.n})",
+    TopK: lambda n: f"TopK(n={n.n}, keys={list(n.keys)})",
+}
+
+
 def _describe(node: PlanNode) -> str:
-    """One-line logical description (the EXPLAIN half)."""
-    if isinstance(node, Scan):
-        bits = [repr(node.path)]
-        if node.columns:
-            bits.append(f"columns={list(node.columns)}")
-        if node.predicate is not None:
-            bits.append(f"predicate={node.predicate}")
-        if node.chunk_bytes:
-            bits.append(f"chunk_bytes={node.chunk_bytes}")
-        return f"Scan({', '.join(bits)})"
-    if isinstance(node, Filter):
-        return f"Filter({node.predicate})"
-    if isinstance(node, Project):
-        return f"Project({list(node.columns)})"
-    if isinstance(node, Join):
-        return (f"Join(how={node.how!r}, {list(node.left_keys)} = "
-                f"{list(node.right_keys)})")
-    if isinstance(node, Aggregate):
-        return (f"Aggregate(keys={list(node.keys)}, "
-                f"aggs={[(c, op) for c, op in node.aggs]})")
-    if isinstance(node, Sort):
-        return f"Sort({list(node.keys)})"
-    if isinstance(node, Limit):
-        return f"Limit({node.n})"
-    if isinstance(node, TopK):
-        return f"TopK(n={node.n}, keys={list(node.keys)})"
-    return type(node).__name__
+    fn = _DESCRIBE.get(type(node))
+    return fn(node) if fn is not None else type(node).__name__
 
 
 def _roofline(span: dict, ceiling: Optional[float]) -> dict:
@@ -186,7 +189,7 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
     if stats is None:
         stats = new_stats()
     qm = None
-    with metrics.query(f"explain:{type(opt).__name__.lower()}") as q:
+    with metrics.query(f"explain:{node_label(opt)}") as q:
         qm = q
         out = execute(opt, stats, fused=fused, prefetch=prefetch)
         if q is not None:
@@ -196,7 +199,7 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
 
     ceiling = roofline_ceiling_gbps()
     from .plan import topo_nodes
-    nodes = [{"label": type(n).__name__.lower(),
+    nodes = [{"label": node_label(n),
               "desc": _describe(n),
               "metrics": None if id(n) not in spans else
               {**spans[id(n)], **_roofline(spans[id(n)], ceiling)}}

@@ -345,12 +345,32 @@ def _apply_pruning(node: PlanNode, schema: _Schema, req: dict,
 # -- driver ----------------------------------------------------------------
 
 def optimize(plan: PlanNode) -> PlanNode:
-    """Apply all rewrite rules; returns a new plan (input untouched)."""
+    """Apply all rewrite rules; returns a new plan (input untouched).
+
+    Unless ``SRJT_VERIFY=0``, the plan verifier (engine/verify.py) runs on
+    the input plan (build-time checks: unknown columns, join-key dtype
+    mismatches, invalid casts) and again after every rewrite rule,
+    asserting the root output schema is unchanged — a rule that alters the
+    schema raises ``PlanVerificationError("rewrite-schema-change", ...)``
+    instead of producing a silently wrong result."""
+    from ..utils.config import config
+    checker = None
+    if config.verify:
+        from .verify import RewriteChecker
+        checker = RewriteChecker(plan)
     schema = _Schema()
     plan = _fuse_topk(plan, {})
+    if checker is not None:
+        checker.check("fuse_topk", plan)
     plan = _push_filters(plan, schema, {})
+    if checker is not None:
+        checker.check("push_filters", plan)
     plan = _push_scan_predicates(plan, {})
+    if checker is not None:
+        checker.check("push_scan_predicates", plan)
     req: dict = {}
     _collect_required(plan, None, schema, req)
     plan = _apply_pruning(plan, schema, req, {})
+    if checker is not None:
+        checker.check("prune_projections", plan)
     return plan

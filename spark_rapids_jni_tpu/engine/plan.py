@@ -391,6 +391,13 @@ def deserialize(blob: bytes) -> PlanNode:
 
 # -- traversal helpers shared by optimizer/executor ------------------------
 
+def node_label(node: PlanNode) -> str:
+    """Canonical lowercase label for a plan node (``"scan"``, ``"join"``,
+    ...) — the one spelling shared by metrics spans (executor), explain
+    renders, and verifier error paths, so the three always agree."""
+    return type(node).__name__.lower()
+
+
 def topo_nodes(root: PlanNode) -> list:
     """Postorder (children before parents), each shared node once."""
     out: list = []

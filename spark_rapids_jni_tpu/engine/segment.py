@@ -503,6 +503,12 @@ class SegmentCache:
                     "evictions": self.evictions,
                     "size": len(self._entries), "maxsize": self.maxsize}
 
+    def snapshot_keys(self) -> list:
+        """Current cache keys ``(fingerprint, shape_class, build_classes)``
+        — the verifier's shape-class-explosion census reads this."""
+        with self._lock:
+            return list(self._entries)
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
@@ -520,7 +526,7 @@ def run_map_segment(compiled: CompiledSegment, table: Table,
     host sync the whole chain pays, vs one per interpreted Filter)."""
     from ..ops.selection import apply_boolean_mask
     out, live = compiled(table, nvalid)
-    metrics.host_sync()  # the boundary compaction's survivor count
+    metrics.host_sync(label="segment-boundary-compaction")
     return apply_boolean_mask(out, live)
 
 
@@ -528,7 +534,7 @@ def _compact_padded(key_dtypes, kdat, kval, out_aggs, ngroups,
                     names) -> Table:
     """groupby's padded->compact tail for fused outputs (fixed-width only,
     which runtime eligibility guarantees)."""
-    metrics.host_sync()
+    metrics.host_sync(label="groupby-compaction")
     ng = int(ngroups)  # the one host sync
     cols = []
     for dtype, data, valid in zip(key_dtypes, kdat, kval):
@@ -574,7 +580,7 @@ def combine_partials(partials: list, compiled: CompiledSegment) -> Table:
     from .executor import _STREAM_COMBINE
     agg = compiled.segment.agg
     nk = len(agg.keys)
-    metrics.host_sync()  # the combine-sizing scalar fetch
+    metrics.host_sync(label="combine-sizing")  # the sizing scalar fetch
     maxng = int(jnp.max(jnp.stack([jnp.asarray(p[4]) for p in partials])))
     cap = 64
     while cap < maxng:

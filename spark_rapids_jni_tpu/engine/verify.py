@@ -1,0 +1,733 @@
+"""Static plan verification + compiled-artifact linting.
+
+The engine's pre-execution analysis layer (docs/ANALYSIS.md), playing the
+role the reference repo's JNI shim plays at the Java boundary: type-check
+the work BEFORE any kernel runs.  Two of the three lint passes live here
+(the third — the repo AST lint — is ``tools/srjt_lint.py``):
+
+1. **Plan verifier** — schema/dtype inference propagated bottom-up over the
+   plan DAG.  Every plan-node class has an ``infer_schema`` rule in the
+   ``_INFER`` dispatch table (the exhaustiveness lint asserts it stays
+   total), producing an ordered ``{name: DType}`` for the node's output.
+   Build-time checks fire during inference — unknown columns, join-key
+   dtype-family mismatches, invalid casts (string vs non-string
+   comparisons), aggregating strings with numeric ops — raising a
+   structured :class:`PlanVerificationError` that carries the node path
+   from the root (``root.child.left`` ...).  ``optimizer.optimize`` runs a
+   :class:`RewriteChecker` after every rewrite rule, so a rule that changes
+   the root output schema is an immediate failure instead of a wrong
+   result, and ``bridge/server`` PLAN_EXECUTE verifies before executing.
+
+2. **Compiled-artifact linter** — ``lint_plan_artifacts`` mirrors the
+   executor's segment selection (``plan_segments``), lowers each fused
+   segment's program to a jaxpr with ``jax.make_jaxpr`` over a zero-filled
+   input table — tracing only, nothing executes — and statically asserts
+   the chunk-program contract: no host callbacks (``pure_callback`` etc.),
+   no trace-time concretization (a ``.item()``/``float()`` smuggled into a
+   traced path fails the lint, not a production run), prepared-build
+   pytree args device-resident, and the deliberate host-sync budget.
+   ``sync_budget`` is the static model of the three whitelisted sync
+   sites in engine/segment.py (the "3 deliberate host syncs" contract of
+   docs/OBSERVABILITY.md): a fused map segment pays one
+   ``segment-boundary-compaction``, a fused agg segment one
+   ``groupby-compaction``, and a streamed agg segment a ``combine-sizing``
+   plus the compaction.  ``lint_segment_cache`` flags fingerprints whose
+   compiled-variant count says unpadded dynamic shapes are exploding the
+   (fingerprint, shape-class) SEGMENT_CACHE.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..dtypes import BOOL8, FLOAT64, INT64, LIST, DType
+from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
+                   Sort, TopK, node_label, topo_nodes)
+
+#: the deliberate host-sync sites engine/segment.py is allowed to pay
+#: (metrics.host_sync labels; the AST lint in tools/srjt_lint.py rejects
+#: any new metrics.host_sync call site outside this whitelist)
+SYNC_WHITELIST = (
+    "segment-boundary-compaction",  # run_map_segment's survivor count
+    "combine-sizing",               # combine_partials' max(ngroups) fetch
+    "groupby-compaction",           # _compact_padded's ngroups fetch
+)
+
+#: jaxpr primitives that would smuggle host work into a chunk program
+_FORBIDDEN_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+})
+
+#: aggregate ops that require a numeric (or decimal) input column
+_NUMERIC_AGGS = frozenset({"sum", "mean", "var", "std", "sumsq", "fsum"})
+
+
+class PlanVerificationError(ValueError):
+    """A plan failed a build-time check.
+
+    Structured so the bridge can ship it as a machine-parseable error
+    reply: ``code`` names the check (``unknown-column``,
+    ``join-key-dtype-mismatch``, ``invalid-cast``, ``aggregate-over-string``,
+    ``rewrite-schema-change``, ``unknown-node``), ``node_path`` locates the
+    offending node from the root (``root.child.left`` ...).
+    """
+
+    def __init__(self, code: str, node_path: str, message: str):
+        self.code = code
+        self.node_path = node_path
+        self.message = message
+        super().__init__(f"{code} at {node_path}: {message}")
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "node_path": self.node_path,
+                "message": self.message}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanVerificationError":
+        return cls(d.get("code", "unknown"), d.get("node_path", "?"),
+                   d.get("message", ""))
+
+
+class SchemaResolver:
+    """Caches scan-file footer schemas as ordered ``{name: DType}``.
+
+    Unreadable/missing files resolve to ``None`` (schema unknown): the
+    verifier then skips schema-dependent checks for that subtree and the
+    executor surfaces the I/O error at run time, exactly as before — a
+    missing file is an execution failure, not a plan-verification one.
+    """
+
+    def __init__(self):
+        self._files: dict = {}
+
+    def file_schema(self, node: Scan) -> Optional[dict]:
+        key = (node.format, node.path)
+        if key not in self._files:
+            try:
+                if node.format == "parquet":
+                    from ..io import ParquetFile
+                    self._files[key] = {c.name: c.dtype
+                                        for c in ParquetFile(node.path).schema}
+                else:
+                    from ..io import ORCFile
+                    self._files[key] = dict(ORCFile(node.path).schema)
+            except Exception:
+                self._files[key] = None
+        sc = self._files[key]
+        return None if sc is None else dict(sc)
+
+
+# -- dtype classification ---------------------------------------------------
+
+def _lit_dtype(value) -> Optional[DType]:
+    if isinstance(value, bool):
+        return BOOL8
+    if isinstance(value, int):
+        return INT64
+    if isinstance(value, float):
+        return FLOAT64
+    if isinstance(value, str):
+        from ..dtypes import STRING
+        return STRING
+    return None  # None/other literals: unknown, checks skip
+
+
+def _cast_family(dt: Optional[DType]) -> Optional[str]:
+    """Coarse comparability family: comparisons may mix anything scalar
+    (ints, floats, bools, timestamps-as-ints) but never string vs
+    non-string or nested."""
+    if dt is None:
+        return None
+    if dt.is_string:
+        return "string"
+    if dt.is_nested:
+        return "nested"
+    return "scalar"
+
+def _key_family(dt: Optional[DType]) -> Optional[str]:
+    """Join-key family: stricter than comparability because equi-joins
+    hash the RAW storage — int64 and float64 keys hash differently, so an
+    integral-vs-floating key pair silently matches nothing."""
+    if dt is None:
+        return None
+    if dt.is_string:
+        return "string"
+    if dt.is_decimal:
+        return ("decimal", dt.scale)
+    if dt.is_timestamp:
+        return "timestamp"
+    if dt.is_floating:
+        return "floating"
+    if dt.is_numeric or dt.id.name == "BOOL8":
+        return "integral"
+    return "other"
+
+
+def _agg_out_dtype(op: str, dt: Optional[DType]) -> Optional[DType]:
+    """Output dtype of one aggregate op (mirrors ops.aggregate)."""
+    if op in ("count", "count_all"):
+        return INT64
+    if op in ("mean", "var", "std", "sumsq", "fsum"):
+        return FLOAT64
+    if op == "collect_list":
+        return LIST
+    if dt is None:
+        return None
+    if op == "sum":
+        if dt.is_floating:
+            return FLOAT64
+        if dt.is_integral:
+            return INT64
+        return dt  # decimal sums keep their scale
+    return dt  # min/max/first/last
+
+
+# -- expression type checking -----------------------------------------------
+
+def _expr_dtype(expr, schema: dict, path: str,
+                node: PlanNode) -> Optional[DType]:
+    """Dtype of a filter expression over ``schema``; raises on unknown
+    columns and string-vs-non-string comparisons (the invalid-cast check —
+    the executor would lower these to a nonsense jnp comparison)."""
+    head = expr[0]
+    if head == "col":
+        if expr[1] not in schema:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"{node_label(node)} references unknown column {expr[1]!r} "
+                f"(available: {sorted(schema)})")
+        return schema[expr[1]]
+    if head == "lit":
+        return _lit_dtype(expr[1])
+    if head == "not":
+        _expr_dtype(expr[1], schema, path, node)
+        return BOOL8
+    a = _expr_dtype(expr[1], schema, path, node)
+    b = _expr_dtype(expr[2], schema, path, node)
+    if head in ("&", "|"):
+        for side in (a, b):
+            if side is not None and (side.is_string or side.is_nested):
+                raise PlanVerificationError(
+                    "invalid-cast", path,
+                    f"{node_label(node)}: boolean operator {head!r} over "
+                    f"non-boolean operand {side!r}")
+        return BOOL8
+    fa, fb = _cast_family(a), _cast_family(b)
+    if "nested" in (fa, fb):
+        raise PlanVerificationError(
+            "invalid-cast", path,
+            f"{node_label(node)}: comparison {head!r} over nested type")
+    if fa is not None and fb is not None and fa != fb:
+        raise PlanVerificationError(
+            "invalid-cast", path,
+            f"{node_label(node)}: comparison {head!r} between {a!r} and "
+            f"{b!r} — string vs non-string needs an explicit cast")
+    return BOOL8
+
+
+# -- per-node infer_schema rules (the verifier dispatch table) --------------
+
+class _Ctx:
+    __slots__ = ("resolver", "memo")
+
+    def __init__(self, resolver: SchemaResolver):
+        self.resolver = resolver
+        self.memo: dict = {}
+
+
+def _infer_scan(node: Scan, path: str, ctx: _Ctx) -> Optional[dict]:
+    file_schema = ctx.resolver.file_schema(node)
+    if node.predicate is not None and file_schema is not None:
+        pcol = node.predicate[0]
+        if pcol not in file_schema:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"scan pruning predicate over unknown column {pcol!r} "
+                f"(file has: {sorted(file_schema)})")
+        pdt = file_schema[pcol]
+        if pdt is not None and (pdt.is_string or pdt.is_nested):
+            raise PlanVerificationError(
+                "invalid-cast", path,
+                f"scan pruning predicate needs a numeric column, "
+                f"{pcol!r} is {pdt!r}")
+    if node.columns is not None:
+        if file_schema is None:
+            # names known, dtypes not: unknown-column checks still work
+            return {c: None for c in node.columns}
+        missing = [c for c in node.columns if c not in file_schema]
+        if missing:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"scan selects unknown column(s) {missing} "
+                f"(file has: {sorted(file_schema)})")
+        return {c: file_schema[c] for c in node.columns}
+    return file_schema
+
+
+def _infer_filter(node: Filter, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _infer(node.child, path + ".child", ctx)
+    if child is not None:
+        _expr_dtype(node.predicate, child, path, node)
+    return child
+
+
+def _infer_project(node: Project, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _infer(node.child, path + ".child", ctx)
+    if child is None:
+        return None
+    missing = [c for c in node.columns if c not in child]
+    if missing:
+        raise PlanVerificationError(
+            "unknown-column", path,
+            f"project selects unknown column(s) {missing} "
+            f"(child has: {sorted(child)})")
+    return {c: child[c] for c in node.columns}
+
+
+def _infer_join(node: Join, path: str, ctx: _Ctx) -> Optional[dict]:
+    left = _infer(node.left, path + ".left", ctx)
+    right = _infer(node.right, path + ".right", ctx)
+    if node.how != "cross":
+        for keys, schema, side in ((node.left_keys, left, "left"),
+                                   (node.right_keys, right, "right")):
+            if schema is None:
+                continue
+            for k in keys:
+                if k not in schema:
+                    raise PlanVerificationError(
+                        "unknown-column", path,
+                        f"join {side} key {k!r} not in {side} input "
+                        f"(has: {sorted(schema)})")
+        if left is not None and right is not None:
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                lf, rf = _key_family(left[lk]), _key_family(right[rk])
+                if lf is not None and rf is not None and lf != rf:
+                    raise PlanVerificationError(
+                        "join-key-dtype-mismatch", path,
+                        f"join key {lk!r} ({left[lk]!r}) vs {rk!r} "
+                        f"({right[rk]!r}): families {lf} vs {rf} hash "
+                        f"differently and would silently match nothing")
+    if node.how in ("semi", "anti"):
+        return left
+    if left is None or right is None:
+        return None
+    rkeys = set(node.right_keys) if node.how != "cross" else set()
+    out = dict(left)
+    for nm, dt in right.items():
+        if nm in rkeys:
+            continue
+        out[nm + ("_r" if nm in left else "")] = dt
+    return out
+
+
+def _infer_aggregate(node: Aggregate, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _infer(node.child, path + ".child", ctx)
+    if child is None:
+        return None
+    for k in node.keys:
+        if k not in child:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"aggregate key {k!r} not in input (has: {sorted(child)})")
+    out = {k: child[k] for k in node.keys}
+    for (cname, op), outname in zip(node.aggs, node.names):
+        if cname is None:
+            out[outname] = INT64  # count_all
+            continue
+        if cname not in child:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"aggregate {op!r} over unknown column {cname!r} "
+                f"(input has: {sorted(child)})")
+        dt = child[cname]
+        if dt is not None and op in _NUMERIC_AGGS and \
+                (dt.is_string or dt.is_nested):
+            raise PlanVerificationError(
+                "aggregate-over-string", path,
+                f"aggregate {op!r} needs a numeric column, "
+                f"{cname!r} is {dt!r}")
+        out[outname] = _agg_out_dtype(op, dt)
+    return out
+
+
+def _check_order_keys(node, keys, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _infer(node.child, path + ".child", ctx)
+    if child is not None:
+        for c, _asc in keys:
+            if c not in child:
+                raise PlanVerificationError(
+                    "unknown-column", path,
+                    f"{node_label(node)} key {c!r} not in input "
+                    f"(has: {sorted(child)})")
+    return child
+
+
+def _infer_sort(node: Sort, path: str, ctx: _Ctx) -> Optional[dict]:
+    return _check_order_keys(node, node.keys, path, ctx)
+
+
+def _infer_topk(node: TopK, path: str, ctx: _Ctx) -> Optional[dict]:
+    return _check_order_keys(node, node.keys, path, ctx)
+
+
+def _infer_limit(node: Limit, path: str, ctx: _Ctx) -> Optional[dict]:
+    return _infer(node.child, path + ".child", ctx)
+
+
+#: plan-node class -> infer_schema rule; tools/srjt_lint.py asserts this
+#: stays exhaustive over plan._NODE_TYPES
+_INFER = {
+    Scan: _infer_scan,
+    Filter: _infer_filter,
+    Project: _infer_project,
+    Join: _infer_join,
+    Aggregate: _infer_aggregate,
+    Sort: _infer_sort,
+    Limit: _infer_limit,
+    TopK: _infer_topk,
+}
+
+
+def _infer(node: PlanNode, path: str, ctx: _Ctx) -> Optional[dict]:
+    if id(node) in ctx.memo:
+        return ctx.memo[id(node)]
+    fn = _INFER.get(type(node))
+    if fn is None:
+        raise PlanVerificationError(
+            "unknown-node", path,
+            f"plan node {type(node).__name__} has no infer_schema rule "
+            f"(register it in verify._INFER)")
+    out = fn(node, path, ctx)
+    ctx.memo[id(node)] = out
+    return out
+
+
+def verify(plan: PlanNode,
+           resolver: Optional[SchemaResolver] = None) -> Optional[dict]:
+    """Type-check ``plan`` bottom-up; returns the root output schema as an
+    ordered ``{name: DType}`` (``None`` when no scan schema resolved).
+
+    Raises :class:`PlanVerificationError` on the first violated build-time
+    check, carrying the check code and the node path from the root.
+    """
+    return _infer(plan, "root", _Ctx(resolver or SchemaResolver()))
+
+
+class RewriteChecker:
+    """Asserts optimizer rewrites preserve the root output schema.
+
+    Built on the ORIGINAL plan (which also runs the build-time checks up
+    front); ``check(rule, plan)`` re-verifies after each rule and raises
+    ``rewrite-schema-change`` if the root schema moved — an optimizer bug
+    caught at plan time instead of a silently wrong result.
+    """
+
+    def __init__(self, plan: PlanNode):
+        self.resolver = SchemaResolver()
+        self.base = verify(plan, self.resolver)
+
+    def check(self, rule: str, plan: PlanNode) -> None:
+        after = verify(plan, self.resolver)
+        if self.base is None or after is None:
+            return  # unresolvable scans: nothing to compare
+        if list(self.base.items()) != list(after.items()):
+            raise PlanVerificationError(
+                "rewrite-schema-change", "root",
+                f"optimizer rule {rule!r} changed the root schema from "
+                f"{list(self.base)} to {list(after)}")
+
+
+# -- pass 2: compiled-artifact lint -----------------------------------------
+
+def node_paths(root: PlanNode) -> dict:
+    """id(node) -> dotted path from the root (first-visit path for shared
+    nodes), matching the paths PlanVerificationError reports."""
+    paths: dict = {}
+
+    def visit(n: PlanNode, p: str) -> None:
+        if id(n) in paths:
+            return
+        paths[id(n)] = p
+        for f in ("child", "left", "right"):
+            c = getattr(n, f, None)
+            if isinstance(c, PlanNode):
+                visit(c, f"{p}.{f}")
+
+    visit(root, "root")
+    return paths
+
+
+def plan_segments(plan: PlanNode, cfg=None) -> list:
+    """The fused segments the executor would form for ``plan`` — the same
+    selection logic as ``_exec``/``_exec_streamed``, run statically: each
+    entry is ``{"kind": "map"|"agg"|"stream-agg", "segment", "node",
+    "path"}``.  Interior chain nodes are consumed by their segment, so the
+    walk (parents before children) never double-roots a chain."""
+    from ..utils.config import config as _config
+    from . import segment as sg
+    from .executor import _stream_scan_of
+    cfg = cfg or _config
+    if not cfg.fuse:
+        return []
+    nparents = sg.parent_counts(plan)
+    paths = node_paths(plan)
+    out: list = []
+    consumed: set = set()
+    for node in reversed(topo_nodes(plan)):
+        if id(node) in consumed:
+            continue
+        if isinstance(node, Aggregate):
+            scan = _stream_scan_of(node)
+            if scan is not None:
+                cand = sg.build_stream_segment(node, scan, nparents,
+                                               fuse_join=cfg.fuse_join)
+                if cand is not None and cand.input is scan \
+                        and sg.worthwhile(cand, streaming=True):
+                    for nd in cand.nodes():
+                        consumed.add(id(nd))
+                    out.append({"kind": "stream-agg", "segment": cand,
+                                "node": node, "path": paths[id(node)]})
+                continue  # streamed-interpreted: no fused artifact
+        if isinstance(node, (Aggregate, Filter, Project)):
+            seg = sg.build_segment(node, nparents)
+            if seg is not None and sg.worthwhile(seg):
+                for nd in seg.nodes():
+                    consumed.add(id(nd))
+                out.append({"kind": "agg" if seg.agg is not None else "map",
+                            "segment": seg, "node": node,
+                            "path": paths[id(node)]})
+    return out
+
+
+def _statically_eligible(seg, resolver: SchemaResolver) -> bool:
+    """Static shadow of runtime_eligible: a string/nested computed-on
+    column makes the executor fall back to the interpreter (segment never
+    runs, no tracked sync).  Unknown dtypes assume eligible."""
+    schema = verify(seg.input, resolver)
+    if schema is None:
+        return True
+    used = set(seg.columns_used())
+    for j in seg.joins():
+        used |= set(j.left_keys)
+    for name in used:
+        dt = schema.get(name)
+        if dt is not None and (dt.is_string or dt.is_nested):
+            return False
+    return True
+
+
+def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
+                cfg=None) -> list:
+    """Static model of the deliberate host syncs an optimized plan pays on
+    the fused paths — one entry per sync, ``site`` naming the whitelisted
+    call site in engine/segment.py.  Mirrors the runtime
+    ``engine.host_sync`` counter: a map segment pays one boundary
+    compaction, an agg segment one groupby compaction, a streamed agg
+    segment a combine-sizing fetch plus the compaction — however many
+    chunks stream through."""
+    resolver = resolver or SchemaResolver()
+    entries: list = []
+    for s in plan_segments(plan, cfg):
+        seg, path = s["segment"], s["path"]
+        if not _statically_eligible(seg, resolver):
+            entries.append({"site": "interpreted-fallback", "path": path,
+                            "count": 0})
+            continue
+        if s["kind"] == "map":
+            entries.append({"site": "segment-boundary-compaction",
+                            "path": path, "count": 1})
+        elif s["kind"] == "agg":
+            entries.append({"site": "groupby-compaction", "path": path,
+                            "count": 1})
+        else:  # stream-agg
+            entries.append({"site": "combine-sizing", "path": path,
+                            "count": 1})
+            entries.append({"site": "groupby-compaction", "path": path,
+                            "count": 1})
+    return entries
+
+
+def check_sync_budget(plans, cfg=None) -> tuple:
+    """``(entries, violations)`` over a set of optimized plans: every
+    entry with a nonzero count must name a whitelisted sync site."""
+    entries: list = []
+    for p in plans:
+        entries += sync_budget(p, cfg=cfg)
+    bad = [e for e in entries
+           if e["count"] and e["site"] not in SYNC_WHITELIST]
+    return entries, bad
+
+
+class _TraceProbe:
+    """Stands in for CompiledSegment when tracing without executing
+    (``_build_fn`` ticks ``traces`` inside the traced function)."""
+
+    __slots__ = ("traces",)
+
+    def __init__(self):
+        self.traces = 0
+
+
+def _zero_table(schema: Optional[dict], rows: int = 8):
+    """A zero-filled device Table matching ``schema`` — just enough
+    structure for make_jaxpr to trace a segment program over it."""
+    if schema is None:
+        return None
+    import jax.numpy as jnp
+
+    from ..columnar import Column, Table
+    from ..dtypes import TypeId
+    cols, names = [], []
+    for nm, dt in schema.items():
+        if dt is None:
+            return None
+        if dt.is_string:
+            cols.append(Column.string(jnp.zeros((0,), jnp.uint8),
+                                      jnp.zeros((rows + 1,), jnp.int32)))
+        elif dt.id == TypeId.DECIMAL128:
+            cols.append(Column(dt, data=jnp.zeros((rows, 2), jnp.int64)))
+        elif dt.is_fixed_width:
+            cols.append(Column(dt, data=jnp.zeros((rows,),
+                                                  dt.device_storage)))
+        else:
+            return None
+        names.append(nm)
+    return Table(cols, names)
+
+
+def _collect_primitives(jaxpr) -> list:
+    """All primitive names in a jaxpr, descending into sub-jaxprs
+    (pjit/scan/cond bodies)."""
+    out: list = []
+    for eqn in jaxpr.eqns:
+        out.append(eqn.primitive.name)
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    out += _collect_primitives(inner)
+                elif hasattr(sub, "eqns"):
+                    out += _collect_primitives(sub)
+    return out
+
+
+def device_resident(tree) -> bool:
+    """True when every pytree leaf is a device array (the prepared-build
+    contract: builds enter the chunk program without host round-trips)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(tree)
+    return all(isinstance(leaf, jax.Array) for leaf in leaves)
+
+
+def lint_segment(seg, input_table, builds: tuple = ()) -> dict:
+    """Lower one segment's program to a jaxpr WITHOUT executing it and
+    lint the artifact: trace must succeed (a ``.item()``/``float()`` on a
+    tracer fails here, statically), no forbidden host-callback primitives,
+    static output shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import segment as sg
+    report = {"fingerprint": seg.fingerprint()[:12], "ok": True,
+              "violations": [], "primitives": 0}
+    fn = sg._build_fn(seg, _TraceProbe())
+    try:
+        closed = jax.make_jaxpr(fn)(
+            input_table, jnp.int32(input_table.num_rows), tuple(builds))
+    except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+        kind = type(e).__name__
+        host = any(t in kind for t in
+                   ("Concretization", "TracerArrayConversion",
+                    "TracerBoolConversion", "TracerIntegerConversion"))
+        report["ok"] = False
+        report["violations"].append({
+            "code": "host-concretization" if host else "trace-failure",
+            "detail": f"{kind}: {e}"[:400]})
+        return report
+    prims = _collect_primitives(closed.jaxpr)
+    report["primitives"] = len(prims)
+    for pname in sorted(set(prims) & _FORBIDDEN_PRIMITIVES):
+        report["ok"] = False
+        report["violations"].append({"code": "forbidden-primitive",
+                                     "detail": pname})
+    for var in closed.jaxpr.outvars:
+        shape = getattr(getattr(var, "aval", None), "shape", ())
+        if not all(isinstance(d, int) for d in shape):
+            report["ok"] = False
+            report["violations"].append({
+                "code": "dynamic-shape",
+                "detail": f"output aval shape {shape} is not static"})
+    return report
+
+
+def lint_plan_artifacts(plan: PlanNode,
+                        resolver: Optional[SchemaResolver] = None,
+                        rows: int = 8, cfg=None) -> dict:
+    """Pass-2 entry point: enumerate the fused segments of an OPTIMIZED
+    plan, jaxpr-lint each one over a zero-filled input, check prepared
+    builds stay device-resident, and attach the static sync budget.
+
+    Returns ``{"segments": [...], "syncs": [...], "violations": [...]}``;
+    an empty ``violations`` list is the pass."""
+    resolver = resolver or SchemaResolver()
+    reports: list = []
+    violations: list = []
+    for s in plan_segments(plan, cfg):
+        seg = s["segment"]
+        schema = verify(seg.input, resolver)
+        tbl = _zero_table(schema, rows)
+        if tbl is None or not _statically_eligible(seg, resolver):
+            reports.append({"path": s["path"], "kind": s["kind"],
+                            "skipped": "input schema unknown or segment "
+                                       "interpreted at runtime"})
+            continue
+        builds: tuple = ()
+        joins = seg.joins()
+        if joins:
+            bts = [_zero_table(verify(j.right, resolver), rows)
+                   for j in joins]
+            if any(b is None for b in bts):
+                reports.append({"path": s["path"], "kind": s["kind"],
+                                "skipped": "build-side schema unknown"})
+                continue
+            from ..ops.join import prepare_build
+            builds = tuple(prepare_build(bt, list(j.right_keys))
+                           for j, bt in zip(joins, bts))
+            for j, pb in zip(joins, builds):
+                if not device_resident(pb):
+                    violations.append({
+                        "code": "host-resident-build", "path": s["path"],
+                        "detail": f"prepared build for join keys "
+                                  f"{list(j.right_keys)} has non-device "
+                                  f"pytree leaves"})
+        rep = lint_segment(seg, tbl, builds)
+        rep["path"], rep["kind"] = s["path"], s["kind"]
+        reports.append(rep)
+        violations += [{**v, "path": s["path"]} for v in rep["violations"]]
+    syncs = sync_budget(plan, resolver, cfg)
+    violations += [{"code": "unwhitelisted-host-sync", "path": e["path"],
+                    "detail": e["site"]}
+                   for e in syncs
+                   if e["count"] and e["site"] not in SYNC_WHITELIST]
+    return {"segments": reports, "syncs": syncs, "violations": violations}
+
+
+def lint_segment_cache(cache=None, max_shape_classes: int = 8) -> list:
+    """Shape-class-explosion census over a SegmentCache: a fingerprint
+    compiled under more than ``max_shape_classes`` distinct shape classes
+    means unpadded dynamic shapes are retracing per chunk instead of
+    re-entering one executable (io/staging.py's power-of-two buckets exist
+    to prevent exactly this)."""
+    if cache is None:
+        from .segment import SEGMENT_CACHE
+        cache = SEGMENT_CACHE
+    by_fp: dict = {}
+    for fp, sc, bsc in cache.snapshot_keys():
+        by_fp.setdefault(fp, set()).add((sc, bsc))
+    return [{"code": "shape-class-explosion", "fingerprint": fp[:12],
+             "shape_classes": len(v),
+             "detail": f"{len(v)} compiled shape variants "
+                       f"(> {max_shape_classes}): inputs are not padding "
+                       f"to stable row buckets"}
+            for fp, v in sorted(by_fp.items()) if len(v) > max_shape_classes]

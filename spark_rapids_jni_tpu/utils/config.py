@@ -22,6 +22,7 @@ environment flags read once at import:
 | ``SRJT_TIMELINE``     | ``0``   | in-process trace-event timeline (utils/timeline.py, Perfetto-loadable JSON) |
 | ``SRJT_TIMELINE_CAP`` | ``16384`` | timeline ring-buffer capacity (events; oldest dropped) |
 | ``SRJT_LOG_FORMAT``   | ``text``| ``json`` emits one JSON object per log line (ts/level/logger/msg + active query) |
+| ``SRJT_VERIFY``       | ``1``   | static plan verification in optimize()/PLAN_EXECUTE (engine/verify.py) |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -70,6 +71,7 @@ class Config:
     timeline: bool = False       # trace-event timeline (utils/timeline.py)
     timeline_cap: int = 16384    # timeline ring-buffer capacity (events)
     log_format: str = "text"     # "text" | "json" (structured log lines)
+    verify: bool = True          # static plan verification (engine/verify.py)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -90,6 +92,7 @@ class Config:
             timeline_cap=_int_flag("SRJT_TIMELINE_CAP", 16384, minimum=16),
             log_format=os.environ.get("SRJT_LOG_FORMAT",
                                       "text").strip().lower(),
+            verify=_bool_flag("SRJT_VERIFY", True),
         )
 
 

@@ -15,7 +15,9 @@ import pytest
 
 from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
 from spark_rapids_jni_tpu.bridge import protocol as P
-from spark_rapids_jni_tpu.engine import Aggregate, Join, Scan, Sort
+from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Join,
+                                         PlanVerificationError, Scan, Sort,
+                                         col, lit)
 
 
 @pytest.fixture(scope="module")
@@ -127,5 +129,34 @@ def test_plan_execute_error_discipline(server):
     c.ping()
     with pytest.raises(RuntimeError):  # scan of a missing file
         c.execute_plan(Scan("/nonexistent/q.parquet"))
+    c.ping()
+    c.close()
+
+
+def test_plan_execute_structured_verification_error(server, files):
+    """A plan failing build-time verification comes back as a
+    PlanVerificationError with the check code and node path intact — the
+    server verifies BEFORE executing, so the reply is a structured error
+    document, not a traceback string from deep inside a chunk loop."""
+    c = BridgeClient(server)
+    bad = Sort(Filter(Scan(files / "fact.parquet"),
+                      (">", col("nope"), lit(1))), (("k", True),))
+    with pytest.raises(PlanVerificationError) as ei:
+        c.execute_plan(bad)
+    assert ei.value.code == "unknown-column"
+    assert ei.value.node_path == "root.child"
+    assert "nope" in ei.value.message
+    c.ping()  # server survived
+
+    # dtype-family mismatch on join keys: also structured
+    pq.write_table(pa.table({"w": pa.array(np.zeros(4))}),
+                   files / "floatdim.parquet")
+    mismatch = Join(Scan(files / "fact.parquet"),
+                    Scan(files / "floatdim.parquet"), ["k"], ["w"],
+                    how="inner")
+    with pytest.raises(PlanVerificationError) as ei:
+        c.execute_plan(mismatch)
+    assert ei.value.code == "join-key-dtype-mismatch"
+    assert ei.value.node_path == "root"
     c.ping()
     c.close()

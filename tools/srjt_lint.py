@@ -1,0 +1,317 @@
+#!/usr/bin/env python
+"""Repo lint for the engine's static invariants (docs/ANALYSIS.md pass 3).
+
+Three stdlib-``ast`` rules over ``spark_rapids_jni_tpu/``:
+
+- **traced-host-op** — no ``.item()`` / ``float()`` / ``bool()`` / ``int()``
+  / ``np.asarray`` / ``.tolist()`` / ``jax.device_get`` /
+  ``.block_until_ready()`` inside the segment-traced code paths
+  (``segment._build_fn`` / ``segment._probe_join_node`` /
+  ``executor._eval_expr``): any of these concretizes a tracer, turning the
+  zero-sync fused chunk program into a per-chunk host round-trip.
+- **config-env-read** — ``os.environ`` / ``os.getenv`` only in
+  ``utils/config.py``; everything else reads the ``config`` singleton so
+  ``refresh()`` stays the one switchboard.  Pre-existing sites are
+  grandfathered in ``ci/lint-baseline.json``.
+- **host-sync-site** — every ``metrics.host_sync(...)`` call site must
+  carry a ``label=`` that is a literal member of ``verify.SYNC_WHITELIST``:
+  adding a fourth deliberate sync means adding it to the whitelist, in
+  one reviewable diff.
+
+Plus two import-time passes:
+
+- **dispatch exhaustiveness** — every class in ``plan._NODE_TYPES`` must be
+  registered in ``executor._EXEC_DISPATCH``, ``explain._DESCRIBE``, and
+  ``verify._INFER`` (a new plan node can't silently miss a layer).
+- **``--segments``** — build the bench smoke warehouse in a tempdir, lower
+  the optimized q5-lite + chunked plans' fused segments to jaxprs
+  (``verify.lint_plan_artifacts``, nothing executes) and assert the static
+  sync budget is EXACTLY the three whitelisted host syncs.  ``--full``
+  extends the plan set with the bench join + top-k shapes (nightly).
+
+Usage::
+
+    python tools/srjt_lint.py --baseline ci/lint-baseline.json
+    python tools/srjt_lint.py --segments --baseline ci/lint-baseline.json
+    python tools/srjt_lint.py --write-baseline   # regenerate the baseline
+
+Violations not covered by the baseline exit nonzero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "spark_rapids_jni_tpu"
+
+#: file (repo-relative) -> function names whose bodies are jax-traced
+TRACED_FUNCS = {
+    f"{PKG}/engine/segment.py": {"_build_fn", "_probe_join_node"},
+    f"{PKG}/engine/executor.py": {"_eval_expr"},
+}
+
+#: attribute calls that concretize a tracer / pull data to host
+_HOST_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
+#: builtin casts that concretize when applied to a traced array
+_HOST_NAME_CALLS = {"float", "int", "bool"}
+
+
+def _violation(code: str, path: str, line: int, detail: str) -> dict:
+    return {"code": code, "file": path, "line": line, "detail": detail}
+
+
+def baseline_key(v: dict) -> str:
+    # line numbers excluded so unrelated edits above a grandfathered
+    # site don't churn the baseline
+    return f"{v['code']}|{v['file']}|{v['detail']}"
+
+
+class _FileLint(ast.NodeVisitor):
+    def __init__(self, relpath: str, whitelist: tuple):
+        self.relpath = relpath
+        self.traced = TRACED_FUNCS.get(relpath, set())
+        self.whitelist = whitelist
+        self.out: list = []
+        self._traced_depth = 0
+
+    def visit_FunctionDef(self, node):
+        entered = node.name in self.traced
+        if entered:
+            self._traced_depth += 1
+        self.generic_visit(node)
+        if entered:
+            self._traced_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_traced_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _HOST_ATTR_CALLS:
+                self.out.append(_violation(
+                    "traced-host-op", self.relpath, node.lineno,
+                    f".{fn.attr}() in traced code"))
+            elif fn.attr in ("asarray", "array") and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "np":
+                self.out.append(_violation(
+                    "traced-host-op", self.relpath, node.lineno,
+                    f"np.{fn.attr}() in traced code"))
+            elif fn.attr == "device_get":
+                self.out.append(_violation(
+                    "traced-host-op", self.relpath, node.lineno,
+                    "jax.device_get() in traced code"))
+        elif isinstance(fn, ast.Name) and fn.id in _HOST_NAME_CALLS:
+            if not (node.args and isinstance(node.args[0], ast.Constant)):
+                self.out.append(_violation(
+                    "traced-host-op", self.relpath, node.lineno,
+                    f"{fn.id}() cast in traced code"))
+
+    def _check_host_sync(self, node: ast.Call) -> None:
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "host_sync"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "metrics"):
+            return
+        labels = [kw.value.value for kw in node.keywords
+                  if kw.arg == "label"
+                  and isinstance(kw.value, ast.Constant)]
+        if not labels or labels[0] not in self.whitelist:
+            self.out.append(_violation(
+                "host-sync-site", self.relpath, node.lineno,
+                f"metrics.host_sync label {labels[0]!r} not in "
+                f"SYNC_WHITELIST" if labels else
+                "metrics.host_sync without a whitelisted literal label="))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._traced_depth:
+            self._check_traced_call(node)
+        self._check_host_sync(node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.relpath != f"{PKG}/utils/config.py" and \
+                isinstance(node.value, ast.Name) and node.value.id == "os" \
+                and node.attr in ("environ", "getenv"):
+            self.out.append(_violation(
+                "config-env-read", self.relpath, node.lineno,
+                f"os.{node.attr} outside utils/config.py"))
+        self.generic_visit(node)
+
+
+def ast_pass(whitelist: tuple) -> list:
+    violations: list = []
+    for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, PKG)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            rel = os.path.relpath(full, REPO)
+            with open(full) as f:
+                tree = ast.parse(f.read(), filename=rel)
+            lint = _FileLint(rel, whitelist)
+            lint.visit(tree)
+            violations += lint.out
+    return violations
+
+
+def dispatch_pass() -> list:
+    import importlib
+
+    from spark_rapids_jni_tpu.engine import executor, explain, plan
+
+    # engine/__init__ re-exports the verify() function under the submodule's
+    # name, so resolve the module through importlib
+    verify_mod = importlib.import_module("spark_rapids_jni_tpu.engine.verify")
+    tables = (("executor._EXEC_DISPATCH", executor._EXEC_DISPATCH),
+              ("explain._DESCRIBE", explain._DESCRIBE),
+              ("verify._INFER", verify_mod._INFER))
+    out: list = []
+    for cls in plan._NODE_TYPES.values():
+        for name, table in tables:
+            if cls not in table:
+                out.append(_violation(
+                    "dispatch-missing", f"{PKG}/engine/plan.py", 0,
+                    f"{cls.__name__} not registered in {name}"))
+    for name, table in tables:
+        for cls in table:
+            if cls not in plan._NODE_TYPES.values():
+                out.append(_violation(
+                    "dispatch-missing", f"{PKG}/engine/plan.py", 0,
+                    f"{name} entry {cls.__name__} is not a plan node"))
+    return out
+
+
+#: the smoke pair's exact budget: q5's one fused map segment + the chunked
+#: plan's streamed agg (sizing + compaction) — 3 syncs, one per whitelisted
+#: site (docs/OBSERVABILITY.md's "3 deliberate host syncs")
+SMOKE_EXPECTED_SYNCS = 3
+
+
+def _full_plans(tmp: str):
+    """The nightly extension: bench-shaped join + top-k plans."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Join, Limit,
+                                             Scan, Sort, col, lit)
+    rng = np.random.default_rng(11)
+    n = 4000
+    fact = os.path.join(tmp, "lint_fact.parquet")
+    dim = os.path.join(tmp, "lint_dim.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 2000, n).astype(np.int64)),
+        "v": pa.array(rng.uniform(-5, 50, n)),
+    }), fact, row_group_size=n // 8)
+    pq.write_table(pa.table({
+        "dk": pa.array(np.arange(2000, dtype=np.int64)),
+        "grp": pa.array((np.arange(2000) % 7).astype(np.int64)),
+    }), dim)
+    fscan = Scan(fact, chunk_bytes=24_000)
+    join_agg = Aggregate(
+        Join(Filter(fscan, (">", col("v"), lit(0.0))), Scan(dim),
+             ("k",), ("dk",), "inner"),
+        ("grp",), (("v", "sum"), ("v", "count")), ("total", "n"))
+    topk = Limit(Sort(Scan(fact, chunk_bytes=24_000),
+                      (("v", False), ("k", True))), 32)
+    return {"join_agg": join_agg, "topk": topk}
+
+
+def segments_pass(full: bool = False) -> list:
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import bench
+    from spark_rapids_jni_tpu.engine import optimize
+    from spark_rapids_jni_tpu.engine.verify import (check_sync_budget,
+                                                    lint_plan_artifacts)
+    out: list = []
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.default_rng(7)
+        bench._pipeline_warehouse(tmp, 4000, rng)
+        q5, chunked = bench._pipeline_plans(tmp, 48_000)
+        plans = {"q5": optimize(q5), "chunked": optimize(chunked)}
+        entries, bad = check_sync_budget(list(plans.values()))
+        smoke_syncs = sum(e["count"] for e in entries)
+        for e in bad:
+            out.append(_violation("unwhitelisted-host-sync", "<smoke>", 0,
+                                  f"{e['site']} at {e['path']}"))
+        if smoke_syncs != SMOKE_EXPECTED_SYNCS:
+            out.append(_violation(
+                "sync-budget-mismatch", "<smoke>", 0,
+                f"smoke plans budget {smoke_syncs} syncs, expected "
+                f"{SMOKE_EXPECTED_SYNCS} "
+                f"({[(e['site'], e['count']) for e in entries]})"))
+        if full:
+            plans.update({k: optimize(p)
+                          for k, p in _full_plans(tmp).items()})
+        for name, plan in plans.items():
+            rep = lint_plan_artifacts(plan)
+            for v in rep["violations"]:
+                out.append(_violation(v["code"], f"<plan:{name}>", 0,
+                                      f"{v.get('path', '?')}: "
+                                      f"{v.get('detail', '')}"))
+            nseg = sum(1 for s in rep["segments"] if "skipped" not in s)
+            print(f"srjt-lint: {name}: {nseg} segment artifact(s) linted, "
+                  f"{len(rep['violations'])} violation(s)")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=None,
+                    help="JSON baseline of grandfathered violation keys")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite --baseline (default ci/lint-baseline.json)"
+                         " from the current violations")
+    ap.add_argument("--segments", action="store_true",
+                    help="also jaxpr-lint the smoke plans' fused segments")
+    ap.add_argument("--full", action="store_true",
+                    help="with --segments: extend to the bench join/top-k "
+                         "plan shapes")
+    args = ap.parse_args(argv)
+
+    # import-time passes need the engine importable without a device
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+    from spark_rapids_jni_tpu.engine.verify import SYNC_WHITELIST
+
+    violations = ast_pass(tuple(SYNC_WHITELIST))
+    violations += dispatch_pass()
+    if args.segments or args.full:
+        violations += segments_pass(full=args.full)
+
+    baseline_path = args.baseline or os.path.join(REPO, "ci",
+                                                  "lint-baseline.json")
+    if args.write_baseline:
+        keys = sorted({baseline_key(v) for v in violations})
+        with open(baseline_path, "w") as f:
+            json.dump({"grandfathered": keys}, f, indent=2)
+            f.write("\n")
+        print(f"srjt-lint: wrote {len(keys)} baseline key(s) to "
+              f"{baseline_path}")
+        return 0
+
+    grandfathered: set = set()
+    if args.baseline and os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            grandfathered = set(json.load(f).get("grandfathered", []))
+
+    fresh = [v for v in violations if baseline_key(v) not in grandfathered]
+    old = len(violations) - len(fresh)
+    for v in fresh:
+        print(f"srjt-lint: {v['code']}: {v['file']}:{v['line']}: "
+              f"{v['detail']}")
+    print(f"srjt-lint: {len(fresh)} new violation(s), {old} grandfathered")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
