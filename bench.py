@@ -926,6 +926,172 @@ def bench_engine_join(n=400_000, chunk_bytes=512_000, smoke=False):
     }
 
 
+def bench_engine_dist(n_fact=240_000, n_dim=2_000, smoke=False):
+    """Partitioning-aware distributed planning: broadcast vs shuffle join.
+
+    The deployment has one physical chip, so (like the SMJ bench above)
+    the 8-device plans run in a subprocess on the virtual CPU mesh.  Four
+    configurations of the same join+aggregate plan:
+
+    - **broadcast**: dim under ``SRJT_BROADCAST_ROWS`` — the planner
+      replicates the build side, probe chunks stream through the fused
+      probe-join segment with zero probe-side exchange.
+    - **exchange**: ``SRJT_BROADCAST_ROWS=0`` forces hash exchanges on
+      both join sides (the partial agg still pushes below its exchange).
+    - **smj**: the r5 shuffle+SortMergeJoin comparator
+      (``distributed_join``) on the same data, join stage only —
+      ``broadcast_vs_smj8`` is the stage-for-stage A/B against the
+      broadcast-hash join stage the planner picks (replicate the build +
+      shard-local hash probe) on the same in-memory tables.
+    - **co-partitioned**: scans declared partitioned on the join keys,
+      aggregate grouped on the partition key — must plan ZERO exchanges
+      (verified, and the static census must match the executed count).
+
+    Reports wall times, the broadcast_vs_smj8 / broadcast_vs_exchange
+    ratios, exchange counts (static and executed), and result parity.
+    """
+    import subprocess
+    import os
+    import sys as _sys
+    script = f"""
+import json, os, tempfile, time
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import spark_rapids_jni_tpu
+import jax
+root = tempfile.mkdtemp()
+rng = np.random.default_rng(9)
+nf, nd = {n_fact}, {n_dim}
+# a wide fact: the shuffle pays wire for every payload column, the
+# broadcast join pays none of them (the representative star-schema case)
+k = rng.integers(0, nd, nf)
+v = np.round(rng.uniform(0, 100, nf), 3)
+v2 = rng.integers(-100, 100, nf)
+v3 = rng.integers(0, 1000, nf)
+pq.write_table(pa.table({{"k": pa.array(k, pa.int64()),
+                          "v": pa.array(v, pa.float64()),
+                          "v2": pa.array(v2, pa.int64()),
+                          "v3": pa.array(v3, pa.int64())}}),
+               os.path.join(root, "fact.parquet"), row_group_size=32_000)
+dk = np.arange(nd, dtype=np.int64)
+pq.write_table(pa.table({{"dk": pa.array(dk), "grp": pa.array(dk % 7)}}),
+               os.path.join(root, "dim.parquet"))
+
+from spark_rapids_jni_tpu.engine import (Aggregate, Join, Scan, execute,
+                                         new_stats, optimize)
+from spark_rapids_jni_tpu.engine.verify import (check_partitioning,
+                                                plan_exchanges, verify)
+from spark_rapids_jni_tpu.utils.config import refresh
+fact, dim = os.path.join(root, "fact.parquet"), os.path.join(root,
+                                                             "dim.parquet")
+
+def mkplan(**scan_kw):
+    j = Join(Scan(fact, chunk_bytes=192_000, **scan_kw.get("f", {{}})),
+             Scan(dim, **scan_kw.get("d", {{}})), ("k",), ("dk",), "inner")
+    return Aggregate(j, ("grp",),
+                     (("v", "sum"), ("v2", "sum"), ("v3", "sum"),
+                      ("v", "count")),
+                     ("total", "t2", "t3", "n"))
+
+def timed(opt):
+    stats = new_stats()
+    execute(opt, new_stats())                       # warm (compile)
+    t0 = time.perf_counter()
+    out = execute(opt, stats)
+    jax.block_until_ready([c.data for c in out.columns])
+    return time.perf_counter() - t0, out, stats
+
+def norm(t):
+    cols = sorted(zip(t.names, (c.to_numpy() for c in t.columns)))
+    order = np.argsort(cols[0][1], kind="stable")
+    return [(n, np.round(a[order], 4).tolist()) for n, a in cols]
+
+base_t, base, _ = timed(optimize(mkplan()))
+
+optA = optimize(mkplan(), distribute=True)
+exA = plan_exchanges(optA)
+tA, outA, stA = timed(optA)
+
+os.environ["SRJT_BROADCAST_ROWS"] = "0"
+refresh()
+optB = optimize(mkplan(), distribute=True)
+exB = plan_exchanges(optB)
+tB, outB, stB = timed(optB)
+del os.environ["SRJT_BROADCAST_ROWS"]
+refresh()
+
+# join-stage A/B on the same in-memory tables: the r5 comparator
+# (shuffle both sides + SortMergeJoin) vs the broadcast-hash stage the
+# planner picks (replicate the build, probe shard-locally)
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.parallel import distributed_join, make_mesh
+from spark_rapids_jni_tpu.parallel.mesh import broadcast_table
+mesh = make_mesh(8)
+lt = Table([Column.from_numpy(k.astype(np.int64)),
+            Column.from_numpy(np.arange(nf, dtype=np.int64)),
+            Column.from_numpy(v2.astype(np.int64)),
+            Column.from_numpy(v3.astype(np.int64))],
+           ["k", "v", "v2", "v3"])
+rt = Table([Column.from_numpy(dk), Column.from_numpy(dk % 7)],
+           ["k", "grp"])
+distributed_join(lt, rt, mesh, ["k"])   # warm
+t0 = time.perf_counter()
+smj = distributed_join(lt, rt, mesh, ["k"])
+tC = time.perf_counter() - t0
+inner_join(lt, broadcast_table(rt, mesh), ["k"])   # warm
+t0 = time.perf_counter()
+bj = inner_join(lt, broadcast_table(rt, mesh), ["k"])
+jax.block_until_ready([c.data for c in bj.columns])
+tJ = time.perf_counter() - t0
+assert bj.num_rows == smj.num_rows
+
+optD = optimize(Aggregate(
+    Join(Scan(fact, partitioned_by=("k",)),
+         Scan(dim, partitioned_by=("dk",)), ("k",), ("dk",), "inner"),
+    ("k",), (("v", "sum"),), ("total",)), distribute=True)
+verify(optD)
+check_partitioning(optD)
+exD = plan_exchanges(optD)
+stD = new_stats()
+execute(optD, stD)
+
+print(json.dumps({{
+    "local_s": base_t, "broadcast_s": tA, "exchange_s": tB, "smj_s": tC,
+    "bjoin_s": tJ,
+    "ratios": {{"broadcast_vs_smj8": tC / tJ if tJ else None,
+                "broadcast_vs_exchange": tB / tA if tA else None}},
+    "exchanges": {{"broadcast_static": len(exA),
+                   "broadcast_executed": stA["exchanges"],
+                   "exchange_static": len(exB),
+                   "exchange_executed": stB["exchanges"],
+                   "copartitioned_static": len(exD),
+                   "copartitioned_executed": stD["exchanges"]}},
+    "smj_rows": smj.num_rows,
+    "results_match": bool(norm(outA) == norm(base)
+                          and norm(outB) == norm(base))}}))
+"""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"),
+               JAX_ENABLE_X64="1")
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([_sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=900)
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            print(f"engine-dist bench failed (rc={r.returncode}):\n"
+                  f"{r.stderr[-2000:]}", file=_sys.stderr)
+            return None
+        return json.loads(lines[-1])
+    except Exception as e:
+        print(f"engine-dist bench failed: {e!r}", file=_sys.stderr)
+        return None
+
+
 def smoke():
     """``bench.py --smoke``: tiny shapes through the fused + pipelined
     paths end-to-end, correctness-only (no timing assertions) — wired into
@@ -1009,7 +1175,36 @@ def smoke():
                       "enabled": timeline.enabled(),
                       "path": tpath,
                       "events": tevents}))
-    return 0 if (ok and jok and mok and tok) else 1
+    # fifth line: the distributed planner — broadcast and hash-exchange
+    # plans must match the single-device result, the static exchange
+    # census must equal the executed count, and the co-partitioned plan
+    # must carry ZERO exchanges (premerge asserts all three on this line)
+    dres = bench_engine_dist(n_fact=60_000, n_dim=500, smoke=True)
+    dok = bool(dres and dres["results_match"]
+               and dres["exchanges"]["broadcast_static"]
+               == dres["exchanges"]["broadcast_executed"]
+               and dres["exchanges"]["exchange_static"]
+               == dres["exchanges"]["exchange_executed"]
+               and dres["exchanges"]["copartitioned_static"]
+               == dres["exchanges"]["copartitioned_executed"] == 0)
+    print(json.dumps({"metric": "engine_dist_smoke",
+                      "ok": dok,
+                      "exchanges": dres["exchanges"] if dres else None,
+                      "latency_ms": {} if not dres else {
+                          "broadcast": round(dres["broadcast_s"] * 1e3, 3),
+                          "exchange": round(dres["exchange_s"] * 1e3, 3),
+                          "smj8": round(dres["smj_s"] * 1e3, 3),
+                      },
+                      "ratios": {} if not dres else {
+                          "broadcast_vs_smj8":
+                          round(dres["ratios"]["broadcast_vs_smj8"], 4)
+                          if dres["ratios"]["broadcast_vs_smj8"] else None,
+                          "broadcast_vs_exchange":
+                          round(dres["ratios"]["broadcast_vs_exchange"], 4)
+                          if dres["ratios"]["broadcast_vs_exchange"]
+                          else None,
+                      }}))
+    return 0 if (ok and jok and mok and tok and dok) else 1
 
 
 def main():
@@ -1026,6 +1221,7 @@ def main():
     eng = bench_engine_q5()
     pipe = bench_engine_pipeline()
     ejoin = bench_engine_join()
+    edist = bench_engine_dist()
 
     # vs_baseline is measured/PINNED (BENCH_BASELINES.json), so the ratio is
     # comparable across rounds; the live re-measure of each baseline is
@@ -1177,6 +1373,25 @@ def main():
                         "materialize + full sort + slice on the same "
                         "optimized plan (>1 means streaming wins)"}}
                if ejoin else {}),
+            **({"engine_dist": {
+                "broadcast_s": round(edist["broadcast_s"], 3),
+                "exchange_s": round(edist["exchange_s"], 3),
+                "smj8_s": round(edist["smj_s"], 3),
+                "broadcast_join_stage_s": round(edist["bjoin_s"], 3),
+                "local_s": round(edist["local_s"], 3),
+                "broadcast_vs_smj8": round(
+                    edist["ratios"]["broadcast_vs_smj8"], 3),
+                "broadcast_vs_exchange": round(
+                    edist["ratios"]["broadcast_vs_exchange"], 3),
+                "exchanges": edist["exchanges"],
+                "results_match": edist["results_match"],
+                "note": "partitioning-aware planner on the 8-device CPU "
+                        "mesh: the same join+agg plan as a broadcast-hash "
+                        "join (build replicated, probe streamed through "
+                        "the fused segment) vs forced hash exchanges vs "
+                        "the r5 shuffle+SMJ comparator (join stage only); "
+                        "co-partitioned scans must plan zero exchanges"}}
+               if edist else {}),
             "metrics_snapshot": _metrics_snapshot(),
         },
     }))

@@ -60,6 +60,18 @@ assert evs and all("ph" in e and "name" in e for e in evs), \
     "timeline dump is not Chrome trace-event JSON"
 assert any(e["ph"] == "X" for e in evs), "timeline has no complete spans"
 print("timeline: %d trace events at %s" % (tl[0]["events"], tl[0]["path"]))
+dist = [s for s in snaps if s.get("metric") == "engine_dist_smoke"]
+assert dist, "bench.py --smoke emitted no engine_dist_smoke line"
+assert dist[0]["ok"], "engine_dist_smoke not ok: %r" % dist[0]
+ex = dist[0]["exchanges"]
+# the static exchange census (verify.plan_exchanges) must equal what the
+# executor actually ran, and co-partitioned plans must carry none
+assert ex["broadcast_static"] == ex["broadcast_executed"], ex
+assert ex["exchange_static"] == ex["exchange_executed"], ex
+assert ex["copartitioned_static"] == ex["copartitioned_executed"] == 0, ex
+print("engine dist: exchanges static==executed (%d broadcast-plan, %d "
+      "exchange-plan), co-partitioned 0" % (ex["broadcast_executed"],
+                                            ex["exchange_executed"]))
 '
 
 # bench regression gate, report-only while tolerances are tuned: diffs the

@@ -23,11 +23,11 @@ from typing import Optional
 import jax.numpy as jnp
 
 from ..columnar import Table
-from ..utils import metrics
+from ..utils import metrics, timeline
 from ..utils.memory import table_nbytes
 from ..utils.tracing import op_scope
-from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, TopK, node_label)
+from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
+                   Project, Scan, Sort, TopK, node_label)
 
 #: aggregate ops with a (merge-op) decomposition usable for per-chunk
 #: partials; value = op that combines partial results
@@ -101,7 +101,8 @@ def _filter_table(table: Table, predicate) -> Table:
 def new_stats() -> dict:
     return {"row_groups_pruned": 0, "row_groups_read": 0,
             "chunks": 0, "streamed": False, "nodes": 0,
-            "fused_segments": 0, "pipelined": False, "topk": False}
+            "fused_segments": 0, "pipelined": False, "topk": False,
+            "exchanges": 0}
 
 
 # -- execution context -----------------------------------------------------
@@ -312,6 +313,148 @@ def _exec_limit(node: Limit, memo: dict, stats: dict,
     from ..ops.selection import slice_table
     t = _exec(node.child, memo, stats, ctx)
     return slice_table(t, 0, min(node.n, t.num_rows))
+
+
+#: per-chunk row budget for the streamed hash exchange — bounds the
+#: device-resident working set of one shuffle dispatch
+_EXCHANGE_CHUNK_ROWS = 1 << 16
+
+
+def _exec_exchange(node: Exchange, memo: dict, stats: dict,
+                   ctx: _ExecCtx) -> Table:
+    """Data movement as a plan node: replicate (broadcast) or re-place
+    (hash shuffle) the child's rows across the device mesh.  Output row
+    ORDER is not preserved by the hash kind — exchanges only feed
+    order-insensitive consumers (joins, aggregates)."""
+    child = _exec(node.child, memo, stats, ctx)
+    # counted before any degenerate early-out (1 device, 0 rows) so the
+    # executed count always equals the static verify.plan_exchanges census
+    # — ci/premerge.sh compares the two on the smoke artifact
+    stats["exchanges"] += 1
+    if node.kind == "broadcast":
+        return _broadcast_exchange(node, child)
+    return _hash_exchange(node, child, ctx)
+
+
+def _broadcast_exchange(node: Exchange, table: Table) -> Table:
+    import jax
+
+    from ..parallel.mesh import broadcast_table, make_mesh
+    ndev = len(jax.devices())
+    wire = table_nbytes(table) * max(0, ndev - 1)
+    metrics.count("engine.exchange.broadcasts")
+    metrics.count("engine.exchange.wire_bytes", wire)
+    qm = metrics.current()
+    if qm is not None:
+        qm.node_add(id(node), node_label(node), wire_bytes=wire)
+    if ndev <= 1:
+        return table
+    with timeline.span("engine.exchange.broadcast",
+                       {"wire_bytes": int(wire)}):
+        return broadcast_table(table, make_mesh(ndev))
+
+
+def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
+    """Streamed two-phase hash shuffle of ``table`` over the full mesh.
+
+    Chunks of ``_EXCHANGE_CHUNK_ROWS`` stream through
+    ``shuffle_chunks_pipelined`` (dispatch-ahead overlap keyed to the
+    engine's prefetch depth).  Exactly two deliberate host syncs per
+    exchange, matching ``verify.sync_budget``: one counts-sizing fetch
+    (phase 1 — global when multi-chunk so ONE compiled program serves the
+    stream, inside ``shuffle_table_padded`` when single-chunk) and one
+    ok-mask compaction fetch at the end.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..columnar import Column
+    from ..ops.row_conversion import fixed_width_layout
+    from ..ops.selection import slice_table
+    from ..parallel import shuffle as sh
+    from ..parallel.mesh import (ROW_AXIS, make_mesh, pad_to_multiple,
+                                 shard_table)
+
+    ndev = len(jax.devices())
+    if ndev <= 1 or table.num_rows == 0:
+        return table  # placement over one device is the identity
+
+    plan = None
+    keys = list(node.keys)
+    if any(c.dtype.is_string for c in table.columns):
+        # strings cross the exchange in padded-bucket form; exploded key
+        # words hash consistently for every row of THIS exchange (equal
+        # strings explode identically at one width)
+        from ..parallel.stringplane import (explode_strings,
+                                            reassemble_strings)
+        table, plan = explode_strings(table)
+        keys = plan.exploded_keys(keys)
+
+    mesh = make_mesh(ndev)
+    rows = table.num_rows
+    nchunks = -(-rows // _EXCHANGE_CHUNK_ROWS)
+    row_spec = NamedSharding(mesh, PartitionSpec(ROW_AXIS))
+    layout = fixed_width_layout(table.dtypes())
+
+    def staged(t):
+        padded, n = pad_to_multiple(t, ndev)
+        live = jax.device_put(jnp.arange(padded.num_rows) < n, row_spec)
+        return shard_table(padded, mesh), live
+
+    capacity = None
+    if nchunks > 1:
+        # phase 1 once, globally: every chunk's per-(src, dest) count is
+        # bounded by the whole table's, so one counts sync sizes one
+        # compiled shuffle program for the entire stream
+        padded, _ = pad_to_multiple(table, ndev)
+        counts = sh.partition_counts(shard_table(padded, mesh), mesh, keys,
+                                     n_valid_rows=rows)
+        capacity = sh.cap_bucket(int(counts.max()))
+
+    def chunk_stream():
+        for i in range(nchunks):
+            lo = i * _EXCHANGE_CHUNK_ROWS
+            yield staged(slice_table(table, lo,
+                                     min(rows - lo, _EXCHANGE_CHUNK_ROWS)))
+
+    with timeline.span("engine.exchange.hash", {"chunks": int(nchunks)}):
+        outs = list(sh.shuffle_chunks_pipelined(
+            chunk_stream(), mesh, keys, capacity=capacity,
+            depth=max(1, ctx.prefetch)))
+
+    # one deliberate barrier: the ok masks reach the host and the padded
+    # receive slots compact to live rows (distributed.py's compact idiom)
+    metrics.host_sync(key=id(node), label="exchange-compaction")
+    wire = 0
+    buf = [[] for _ in table.columns]
+    bufv = [[] for _ in table.columns]
+    for out, ok, ovf in outs:
+        if int(np.asarray(ovf)):
+            raise RuntimeError(
+                "hash exchange overflow despite counts-sized capacity")
+        wire += out.num_rows * layout.row_size  # every slot crosses the wire
+        keep = np.asarray(ok)
+        for i, c in enumerate(out.columns):
+            buf[i].append(np.asarray(c.data)[keep])
+            bufv[i].append(np.ones(int(keep.sum()), bool)
+                           if c.validity is None
+                           else np.asarray(c.validity)[keep])
+    metrics.count("engine.exchange.shuffles")
+    metrics.count("engine.exchange.wire_bytes", wire)
+    qm = metrics.current()
+    if qm is not None:
+        qm.node_add(id(node), node_label(node), chunks=nchunks,
+                    wire_bytes=wire)
+    cols = []
+    for dt, ds, vs in zip(table.dtypes(), buf, bufv):
+        v = np.concatenate(vs)
+        cols.append(Column(dt, data=jnp.asarray(np.concatenate(ds)),
+                           validity=None if v.all() else jnp.asarray(v)))
+    result = Table(cols, table.names)
+    if plan is not None:
+        result = reassemble_strings(result, plan)
+    return result
 
 
 def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
@@ -649,6 +792,7 @@ _EXEC_DISPATCH = {
     Sort: _exec_sort,
     Limit: _exec_limit,
     TopK: _exec_topk,
+    Exchange: _exec_exchange,
 }
 
 

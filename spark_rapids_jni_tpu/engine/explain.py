@@ -23,8 +23,8 @@ from typing import Optional
 
 from ..columnar import Table
 from ..utils import metrics
-from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, TopK, node_label)
+from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
+                   Project, Scan, Sort, TopK, node_label)
 
 # -- roofline ceiling --------------------------------------------------------
 
@@ -86,6 +86,8 @@ _DESCRIBE = {
     Sort: lambda n: f"Sort({list(n.keys)})",
     Limit: lambda n: f"Limit({n.n})",
     TopK: lambda n: f"TopK(n={n.n}, keys={list(n.keys)})",
+    Exchange: lambda n: ("Exchange(broadcast)" if n.kind == "broadcast"
+                         else f"Exchange(hash, keys={list(n.keys)})"),
 }
 
 
@@ -131,6 +133,17 @@ def _annotate(span: Optional[dict], ceiling: Optional[float] = None) -> str:
             bits.append(f"GB/s={rf['GBps']:.3f}")
         if rf["roofline_frac"] is not None:
             bits.append(f"roofline_frac={rf['roofline_frac']:.6f}")
+    wire = int(span.get("wire_bytes", 0))
+    if wire:
+        # exchange cost against the same pinned ceiling: wire bytes over
+        # this node's wall time — how close the exchange ran to the roof
+        bits.append(f"wire_bytes={wire}")
+        wall = span.get("wall_s") or 0.0
+        if wall > 0:
+            gbps = wire / wall / 1e9
+            bits.append(f"exch_GB/s={gbps:.3f}")
+            if ceiling:
+                bits.append(f"exch_roofline_frac={gbps / ceiling:.6f}")
     return "[" + " ".join(bits) + "]"
 
 
@@ -212,6 +225,8 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
                 f"nodes={stats['nodes']} chunks={stats['chunks']} "
                 f"streamed={stats['streamed']} "
                 f"fused_segments={stats['fused_segments']}"]
+        if stats.get("exchanges"):
+            foot[0] += f" exchanges={stats['exchanges']}"
         if ceiling:
             foot[0] += f" roofline_ceiling_GBps={ceiling}"
         mem = summary.get("memory")

@@ -26,8 +26,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, TopK, expr_columns, rebuild)
+from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
+                   Project, Scan, Sort, TopK, co_partitioned, expr_columns,
+                   partitioning, rebuild, topo_nodes)
 
 #: comparisons a scan predicate hint can absorb (col vs literal)
 _RANGE_OPS = {">=", "<=", ">", "<", "=="}
@@ -68,6 +69,8 @@ def output_names(node: PlanNode, schema: Optional[_Schema] = None,
         out = output_names(node.child, schema, memo)
     elif isinstance(node, Aggregate):
         out = list(node.keys) + list(node.names)
+    elif isinstance(node, Exchange):
+        out = output_names(node.child, schema, memo)
     elif isinstance(node, Join):
         lnames = output_names(node.left, schema, memo)
         if node.how in ("semi", "anti"):
@@ -298,6 +301,11 @@ def _collect_required(node: PlanNode, needed, schema: _Schema, req: dict):
         _collect_required(node.child, sub, schema, req)
     elif isinstance(node, Limit):
         _collect_required(node.child, needed, schema, req)
+    elif isinstance(node, Exchange):
+        # hash placement reads the key columns even if no ancestor does
+        sub = needed if (needed is None or node.kind != "hash") \
+            else needed | set(node.keys)
+        _collect_required(node.child, sub, schema, req)
     elif isinstance(node, Aggregate):
         sub = set(node.keys) | {c for c, _ in node.aggs if c is not None}
         _collect_required(node.child, sub, schema, req)
@@ -342,9 +350,164 @@ def _apply_pruning(node: PlanNode, schema: _Schema, req: dict,
     return out
 
 
+# -- rule 4: partitioning-aware exchange placement (SRJT_DIST) -------------
+
+#: join types whose RIGHT side may be replicated instead of shuffled: the
+#: output is left-row-driven, so per-device replicas of the build side
+#: never duplicate result rows (right/full would emit their null-extended
+#: right rows once per device)
+_BROADCAST_HOWS = ("inner", "left", "semi", "anti", "cross")
+
+
+def _scan_row_estimate(node: Scan) -> Optional[int]:
+    """Row estimate for one scan from parquet footer metadata — the same
+    row-group stats the pushdown machinery prunes with, reused as the
+    broadcast-vs-shuffle cost input.  A pruning predicate discounts the
+    groups its ``(column, lo, hi)`` hint would skip; ``None`` = unknown."""
+    if node.format != "parquet":
+        return None
+    try:
+        from ..io import ParquetFile
+        f = ParquetFile(node.path)
+        if node.predicate is None:
+            return int(f.num_rows)
+        pcol, lo, hi = node.predicate
+        total = 0
+        for gi in range(f.num_row_groups):
+            st = f.group_stats(gi, pcol)
+            if st is not None:
+                gmin, gmax, _nulls = st
+                if (hi is not None and gmin is not None and gmin > hi) or \
+                        (lo is not None and gmax is not None and gmax < lo):
+                    continue  # this group would be pruned
+            total += f.row_groups[gi].num_rows
+        return total
+    except Exception:
+        return None  # unreadable file: the executor will surface it
+
+
+def _estimate_rows(node: PlanNode, memo: dict) -> Optional[int]:
+    """Upper-bound row estimate per node (None = unknown).  Filters and
+    aggregates only shrink their input; joins can expand, so they don't
+    propagate an estimate."""
+    if id(node) in memo:
+        return memo[id(node)]
+    if isinstance(node, Scan):
+        est = _scan_row_estimate(node)
+    elif isinstance(node, (Filter, Project, Sort, Exchange, Aggregate)):
+        est = _estimate_rows(node.child, memo)
+    elif isinstance(node, (Limit, TopK)):
+        sub = _estimate_rows(node.child, memo)
+        est = node.n if sub is None else min(node.n, sub)
+    else:
+        est = None
+    memo[id(node)] = est
+    return est
+
+
+def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
+                    memo: dict) -> PlanNode:
+    """Insert the minimal exchanges a distributed Join/Aggregate needs.
+
+    Bottom-up so each decision sees the children's (possibly already
+    exchanged) partitioning:
+
+    - **Join**: nothing when the build side is broadcast or the sides are
+      already co-partitioned on the join keys (shuffle elimination by
+      construction).  Otherwise a build whose footer-stats row estimate is
+      at or under ``config.broadcast_rows`` replicates
+      (``Exchange(kind="broadcast")`` — the cached PreparedBuild then
+      serves every probe chunk with zero probe-side exchange); else both
+      sides hash-exchange onto their join keys, skipping any side already
+      placed correctly.
+    - **Aggregate** (grouped): nothing when the input is already placed by
+      a subset of the group keys.  Decomposable aggs split into a partial
+      BELOW the exchange and a combine above it, so only per-device
+      partial rows cross the wire; non-decomposable aggs exchange the full
+      input on the group keys.
+    """
+    if id(node) in memo:
+        return memo[id(node)]
+    kids = {f: _plan_exchanges(getattr(node, f), pmemo, est, memo)
+            for f in ("child", "left", "right") if hasattr(node, f)}
+    out = rebuild(node, **{k: v for k, v in kids.items()
+                           if v is not getattr(node, k)})
+
+    from ..utils.config import config
+    if isinstance(out, Join):
+        lp = partitioning(out.left, pmemo)
+        rp = partitioning(out.right, pmemo)
+        if rp.kind == "broadcast" or (
+                out.how != "cross"
+                and co_partitioned(lp, rp, out.left_keys, out.right_keys)):
+            pass  # already co-located
+        else:
+            rows = _estimate_rows(out.right, est)
+            if out.how in _BROADCAST_HOWS and rows is not None \
+                    and rows <= config.broadcast_rows:
+                out = rebuild(out, right=Exchange(out.right,
+                                                  kind="broadcast"))
+            elif out.how != "cross":
+                left, right = out.left, out.right
+                if not (lp.kind == "hash"
+                        and tuple(lp.keys) == tuple(out.left_keys)):
+                    left = Exchange(left, out.left_keys, "hash")
+                if not (rp.kind == "hash"
+                        and tuple(rp.keys) == tuple(out.right_keys)):
+                    right = Exchange(right, out.right_keys, "hash")
+                out = rebuild(out, left=left, right=right)
+    elif isinstance(out, Aggregate) and out.keys:
+        from .executor import _STREAM_COMBINE
+        p = partitioning(out.child, pmemo)
+        if p.kind == "broadcast" or (p.kind == "hash"
+                                     and set(p.keys) <= set(out.keys)):
+            pass  # every group's rows already share a device
+        elif all(op in _STREAM_COMBINE for _, op in out.aggs):
+            # partial below the exchange: per-device partials are what
+            # crosses the wire, the combine above re-aggregates them.
+            # Dtype-exact: count partials are INT64 and combine by sum
+            # (INT64), sum/min/max combine in their own dtype.
+            partial = Aggregate(out.child, out.keys, out.aggs, out.names)
+            combine = tuple((nm, _STREAM_COMBINE[op])
+                            for nm, (_c, op) in zip(out.names, out.aggs))
+            out = Aggregate(Exchange(partial, out.keys, "hash"),
+                            out.keys, combine, out.names)
+        else:
+            out = rebuild(out, child=Exchange(out.child, out.keys, "hash"))
+    memo[id(node)] = out
+    return out
+
+
+def _eliminate_exchanges(node: PlanNode, pmemo: dict, memo: dict) -> PlanNode:
+    """Drop exchanges whose child is already placed the way they'd place
+    it, and collapse back-to-back exchanges (only the outer placement
+    survives the wire anyway) — the cleanup pass for hand-built plans that
+    carry explicit Exchange nodes."""
+    if id(node) in memo:
+        return memo[id(node)]
+    kids = {f: _eliminate_exchanges(getattr(node, f), pmemo, memo)
+            for f in ("child", "left", "right") if hasattr(node, f)}
+    out = rebuild(node, **{k: v for k, v in kids.items()
+                           if v is not getattr(node, k)})
+    while isinstance(out, Exchange):
+        p = partitioning(out.child, pmemo)
+        if out.kind == "hash" and p.kind == "hash" \
+                and tuple(p.keys) == tuple(out.keys):
+            out = out.child  # child rows already live where we'd send them
+        elif out.kind == "broadcast" and p.kind == "broadcast":
+            out = out.child
+        elif isinstance(out.child, Exchange):
+            out = rebuild(out, child=out.child.child)
+        else:
+            break
+    memo[id(node)] = out
+    return out
+
+
 # -- driver ----------------------------------------------------------------
 
-def optimize(plan: PlanNode) -> PlanNode:
+def optimize(plan: PlanNode,
+             distribute: Optional[bool] = None) -> PlanNode:
     """Apply all rewrite rules; returns a new plan (input untouched).
 
     Unless ``SRJT_VERIFY=0``, the plan verifier (engine/verify.py) runs on
@@ -352,7 +515,13 @@ def optimize(plan: PlanNode) -> PlanNode:
     mismatches, invalid casts) and again after every rewrite rule,
     asserting the root output schema is unchanged — a rule that alters the
     schema raises ``PlanVerificationError("rewrite-schema-change", ...)``
-    instead of producing a silently wrong result."""
+    instead of producing a silently wrong result.
+
+    ``distribute`` turns the partitioning-aware exchange rules on/off per
+    call; the default follows ``SRJT_DIST``.  Shuffle elimination
+    (``_eliminate_exchanges``) also runs on plans that carry hand-placed
+    Exchange nodes even when distribution is off.
+    """
     from ..utils.config import config
     checker = None
     if config.verify:
@@ -368,9 +537,21 @@ def optimize(plan: PlanNode) -> PlanNode:
     plan = _push_scan_predicates(plan, {})
     if checker is not None:
         checker.check("push_scan_predicates", plan)
+    dist = config.distribute if distribute is None else bool(distribute)
+    if dist:
+        plan = _plan_exchanges(plan, {}, {}, {})
+        if checker is not None:
+            checker.check("plan_exchanges", plan)
+    if dist or any(isinstance(n, Exchange) for n in topo_nodes(plan)):
+        plan = _eliminate_exchanges(plan, {}, {})
+        if checker is not None:
+            checker.check("eliminate_exchanges", plan)
     req: dict = {}
     _collect_required(plan, None, schema, req)
     plan = _apply_pruning(plan, schema, req, {})
     if checker is not None:
         checker.check("prune_projections", plan)
+    if dist and config.verify:
+        from .verify import check_partitioning
+        check_partitioning(plan)
     return plan

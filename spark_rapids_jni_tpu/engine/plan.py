@@ -35,6 +35,8 @@ _EXPR_OPS = {">=", "<=", ">", "<", "==", "!=", "&", "|"}
 
 JOIN_HOWS = ("inner", "left", "right", "full", "semi", "anti", "cross")
 
+EXCHANGE_KINDS = ("hash", "broadcast")
+
 #: aggregate ops the executor accepts (mirrors ops.aggregate)
 AGG_OPS = ("sum", "min", "max", "mean", "count", "count_all", "var", "std",
            "sumsq", "fsum", "first", "last", "collect_list")
@@ -156,36 +158,47 @@ class Scan(PlanNode):
     ``predicate`` is the row-group pruning hint ``(column, lo, hi)`` consumed
     by ``ParquetChunkedReader`` — normally installed by the optimizer, not by
     hand.  ``chunk_bytes`` bounds decode passes (``pass_read_limit``) and
-    marks the scan as streamable for partial aggregation.
+    marks the scan as streamable for partial aggregation.  ``partitioned_by``
+    declares that the file's rows are already hash-placed on those columns
+    (the engine's murmur3/pmod placement) — the distributed planner trusts it
+    for shuffle elimination.
     """
     path: str
     format: str = "parquet"
     columns: Optional[Tuple[str, ...]] = None
     predicate: Optional[tuple] = None
     chunk_bytes: Optional[int] = None
+    partitioned_by: Optional[Tuple[str, ...]] = None
 
     def __post_init__(self):
         object.__setattr__(self, "path", str(self.path))
         object.__setattr__(self, "columns", _tup(self.columns))
         object.__setattr__(self, "predicate", _tup(self.predicate))
+        object.__setattr__(self, "partitioned_by", _tup(self.partitioned_by))
         if self.format not in ("parquet", "orc"):
             raise ValueError(f"unknown scan format {self.format!r}")
         if self.predicate is not None and len(self.predicate) != 3:
             raise ValueError("scan predicate must be (column, lo, hi)")
 
     def _node_dict(self, child_ids):
-        return {"path": self.path, "format": self.format,
-                "columns": None if self.columns is None else list(self.columns),
-                "predicate": None if self.predicate is None
-                else list(self.predicate),
-                "chunk_bytes": self.chunk_bytes}
+        d = {"path": self.path, "format": self.format,
+             "columns": None if self.columns is None else list(self.columns),
+             "predicate": None if self.predicate is None
+             else list(self.predicate),
+             "chunk_bytes": self.chunk_bytes}
+        # emitted only when declared so pre-existing plan fingerprints are
+        # byte-identical to the previous serialization
+        if self.partitioned_by is not None:
+            d["partitioned_by"] = list(self.partitioned_by)
+        return d
 
     @classmethod
     def _from_dict(cls, d, built):
         return cls(path=d["path"], format=d.get("format", "parquet"),
                    columns=_tup(d.get("columns")),
                    predicate=_tup(d.get("predicate")),
-                   chunk_bytes=d.get("chunk_bytes"))
+                   chunk_bytes=d.get("chunk_bytes"),
+                   partitioned_by=_tup(d.get("partitioned_by")))
 
 
 @dataclass(frozen=True, eq=False)
@@ -368,8 +381,41 @@ class TopK(PlanNode):
                    keys=tuple(tuple(k) for k in d["keys"]), n=d["n"])
 
 
+@dataclass(frozen=True, eq=False)
+class Exchange(PlanNode):
+    """Data-movement boundary: re-place the child's rows across the device
+    mesh.  ``kind="hash"`` shuffles rows by the engine's murmur3/pmod
+    placement of ``keys`` (Spark-exact for fixed-width keys); ``kind=
+    "broadcast"`` replicates the whole child to every device (the build side
+    of a broadcast-hash join).  Schema-transparent: output columns and dtypes
+    equal the child's.  Inserted by the optimizer's distributed-planning
+    rules, never required by hand-built single-device plans."""
+    child: PlanNode
+    keys: Tuple[str, ...] = ()
+    kind: str = "hash"
+
+    def __post_init__(self):
+        object.__setattr__(self, "keys", tuple(self.keys))
+        if self.kind not in EXCHANGE_KINDS:
+            raise ValueError(f"unknown exchange kind {self.kind!r}")
+        if self.kind == "hash" and not self.keys:
+            raise ValueError("hash exchange requires keys")
+        if self.kind == "broadcast" and self.keys:
+            raise ValueError("broadcast exchange takes no keys")
+
+    def _node_dict(self, child_ids):
+        return {"child": child_ids[0], "keys": list(self.keys),
+                "kind": self.kind}
+
+    @classmethod
+    def _from_dict(cls, d, built):
+        return cls(child=built[d["child"]], keys=tuple(d.get("keys", ())),
+                   kind=d.get("kind", "hash"))
+
+
 _NODE_TYPES = {c.__name__: c for c in
-               (Scan, Filter, Project, Join, Aggregate, Sort, Limit, TopK)}
+               (Scan, Filter, Project, Join, Aggregate, Sort, Limit, TopK,
+                Exchange)}
 
 
 def from_dict(obj: dict) -> PlanNode:
@@ -418,3 +464,84 @@ def topo_nodes(root: PlanNode) -> list:
 def rebuild(node: PlanNode, **changes) -> PlanNode:
     """dataclasses.replace that tolerates no-op calls on frozen nodes."""
     return replace(node, **changes) if changes else node
+
+
+# -- partitioning property -------------------------------------------------
+
+@dataclass(frozen=True)
+class Partitioning:
+    """How a node's output rows are placed across the mesh.
+
+    ``kind`` is ``"none"`` (unknown / single stream), ``"hash"`` (rows placed
+    by murmur3/pmod of ``keys``), or ``"broadcast"`` (every device holds a
+    full replica).  Compared structurally — ``keys`` order is significant
+    because placement hashes the key *tuple* positionally.
+    """
+    kind: str = "none"
+    keys: Tuple[str, ...] = ()
+
+
+NO_PARTITIONING = Partitioning("none", ())
+BROADCAST_PARTITIONING = Partitioning("broadcast", ())
+
+
+def partitioning(node: PlanNode, _memo: Optional[dict] = None) -> Partitioning:
+    """Bottom-up placement property of ``node``'s output.
+
+    Conservative: anything that might scramble row placement degrades to
+    ``"none"``.  A hash partitioning survives operators that neither move
+    rows between devices nor drop the key columns; broadcast survives any
+    per-row operator (every device still holds every row).
+    """
+    memo = {} if _memo is None else _memo
+    if id(node) in memo:
+        return memo[id(node)]
+
+    if isinstance(node, Exchange):
+        p = (BROADCAST_PARTITIONING if node.kind == "broadcast"
+             else Partitioning("hash", node.keys))
+    elif isinstance(node, Scan):
+        p = (Partitioning("hash", node.partitioned_by)
+             if node.partitioned_by else NO_PARTITIONING)
+    elif isinstance(node, (Filter, Sort, Limit, TopK)):
+        # row-local / row-dropping operators never move surviving rows
+        p = partitioning(node.child, memo)
+    elif isinstance(node, Project):
+        p = partitioning(node.child, memo)
+        if p.kind == "hash" and not set(p.keys) <= set(node.columns):
+            p = NO_PARTITIONING
+    elif isinstance(node, Aggregate):
+        p = partitioning(node.child, memo)
+        if p.kind == "hash" and not set(p.keys) <= set(node.keys):
+            p = NO_PARTITIONING
+        elif p.kind == "broadcast" and node.keys:
+            # every device would compute identical full groups — replicated
+            p = BROADCAST_PARTITIONING
+    elif isinstance(node, Join):
+        lp = partitioning(node.left, memo)
+        rp = partitioning(node.right, memo)
+        if node.how != "cross" and (
+                rp.kind == "broadcast"
+                or co_partitioned(lp, rp, node.left_keys, node.right_keys)):
+            # probe rows never move: output inherits the left placement
+            p = lp
+        elif node.how == "cross" and rp.kind == "broadcast":
+            p = lp
+        else:
+            p = NO_PARTITIONING
+    else:
+        raise TypeError(f"partitioning: unknown node {type(node).__name__}")
+
+    memo[id(node)] = p
+    return p
+
+
+def co_partitioned(lp: Partitioning, rp: Partitioning,
+                   left_keys: Tuple[str, ...],
+                   right_keys: Tuple[str, ...]) -> bool:
+    """True when both sides are hash-placed on exactly the join keys (in
+    join-key order), so matching rows are already device-local."""
+    return (lp.kind == "hash" and rp.kind == "hash"
+            and tuple(lp.keys) == tuple(left_keys)
+            and tuple(rp.keys) == tuple(right_keys)
+            and len(left_keys) > 0)

@@ -49,7 +49,11 @@ from ..utils.config import config
 from .plan import (Aggregate, Filter, Join, PlanNode, Project, expr_columns,
                    topo_nodes)
 
-#: chain members fusable into a segment body (everything else is a breaker)
+#: chain members fusable into a segment body (everything else is a
+#: breaker).  Exchange is deliberately NOT here: an exchange re-places
+#: rows across devices, so it must materialize its input — but a
+#: broadcast Exchange on a join's build side stays scan-independent, so
+#: ``build_stream_segment`` still fuses the probe side around it.
 _FUSABLE = (Filter, Project)
 
 #: join types the streamed probe-join program supports (output stays at

@@ -41,16 +41,19 @@ from __future__ import annotations
 from typing import Optional
 
 from ..dtypes import BOOL8, FLOAT64, INT64, LIST, DType
-from .plan import (Aggregate, Filter, Join, Limit, PlanNode, Project, Scan,
-                   Sort, TopK, node_label, topo_nodes)
+from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
+                   Project, Scan, Sort, TopK, co_partitioned, node_label,
+                   partitioning, topo_nodes)
 
-#: the deliberate host-sync sites engine/segment.py is allowed to pay
+#: the deliberate host-sync sites the engine is allowed to pay
 #: (metrics.host_sync labels; the AST lint in tools/srjt_lint.py rejects
 #: any new metrics.host_sync call site outside this whitelist)
 SYNC_WHITELIST = (
     "segment-boundary-compaction",  # run_map_segment's survivor count
     "combine-sizing",               # combine_partials' max(ngroups) fetch
     "groupby-compaction",           # _compact_padded's ngroups fetch
+    "exchange-counts-sizing",       # hash exchange phase-1 counts fetch
+    "exchange-compaction",          # hash exchange ok-mask fetch + compact
 )
 
 #: jaxpr primitives that would smuggle host work into a chunk program
@@ -251,6 +254,13 @@ def _infer_scan(node: Scan, path: str, ctx: _Ctx) -> Optional[dict]:
                 "invalid-cast", path,
                 f"scan pruning predicate needs a numeric column, "
                 f"{pcol!r} is {pdt!r}")
+    if node.partitioned_by is not None and file_schema is not None:
+        missing = [c for c in node.partitioned_by if c not in file_schema]
+        if missing:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"scan partitioned_by references unknown column(s) "
+                f"{missing} (file has: {sorted(file_schema)})")
     if node.columns is not None:
         if file_schema is None:
             # names known, dtypes not: unknown-column checks still work
@@ -375,6 +385,21 @@ def _infer_limit(node: Limit, path: str, ctx: _Ctx) -> Optional[dict]:
     return _infer(node.child, path + ".child", ctx)
 
 
+def _infer_exchange(node: Exchange, path: str, ctx: _Ctx) -> Optional[dict]:
+    """Exchange is schema-transparent: output columns/dtypes equal the
+    child's.  Hash keys must exist in the child schema — a key the executor
+    can't hash is a build-time error, not a runtime KeyError."""
+    child = _infer(node.child, path + ".child", ctx)
+    if node.kind == "hash" and child is not None:
+        missing = [k for k in node.keys if k not in child]
+        if missing:
+            raise PlanVerificationError(
+                "unknown-column", path,
+                f"exchange hash key(s) {missing} not in input "
+                f"(has: {sorted(child)})")
+    return child
+
+
 #: plan-node class -> infer_schema rule; tools/srjt_lint.py asserts this
 #: stays exhaustive over plan._NODE_TYPES
 _INFER = {
@@ -386,6 +411,7 @@ _INFER = {
     Sort: _infer_sort,
     Limit: _infer_limit,
     TopK: _infer_topk,
+    Exchange: _infer_exchange,
 }
 
 
@@ -456,6 +482,63 @@ def node_paths(root: PlanNode) -> dict:
 
     visit(root, "root")
     return paths
+
+
+def plan_exchanges(plan: PlanNode) -> list:
+    """Static census of the Exchange nodes in a plan, in postorder — one
+    entry ``{"path", "kind", "keys"}`` per node.  The executor bumps
+    ``stats["exchanges"]`` once per Exchange regardless of degenerate
+    early-outs (1 device, 0 rows), so ``len(plan_exchanges(p))`` equals the
+    executed count exactly — ci/premerge.sh asserts that on the smoke
+    artifact."""
+    paths = node_paths(plan)
+    return [{"path": paths[id(n)], "kind": n.kind, "keys": list(n.keys)}
+            for n in topo_nodes(plan) if isinstance(n, Exchange)]
+
+
+def check_partitioning(plan: PlanNode) -> None:
+    """Partitioning-consistency check for distributed plans.
+
+    Only meaningful once Exchanges are placed (a plan with none is a plain
+    single-device plan and vacuously consistent).  Raises
+    ``partitioning-mismatch`` when a Join's two sides are hash-placed on
+    different key sets (matching rows could sit on different devices) or an
+    Aggregate's child is hash-placed on keys that are not a subset of the
+    group keys (a group's rows would be split across devices)."""
+    if not any(isinstance(n, Exchange) for n in topo_nodes(plan)):
+        return
+    paths = node_paths(plan)
+    memo: dict = {}
+    # an Aggregate feeding an Exchange is a partial by construction (the
+    # partial-agg pushdown splits one grouped agg into partial-below /
+    # combine-above); its per-device split groups are intended, so the
+    # subset check applies only to the combine side
+    partial_aggs = {id(n.child) for n in topo_nodes(plan)
+                    if isinstance(n, Exchange)}
+    for node in topo_nodes(plan):
+        if isinstance(node, Join) and node.how != "cross":
+            lp = partitioning(node.left, memo)
+            rp = partitioning(node.right, memo)
+            if rp.kind == "broadcast":
+                continue
+            if lp.kind == "hash" and rp.kind == "hash" and \
+                    not co_partitioned(lp, rp, node.left_keys,
+                                       node.right_keys):
+                raise PlanVerificationError(
+                    "partitioning-mismatch", paths[id(node)],
+                    f"join inputs hash-placed on {list(lp.keys)} vs "
+                    f"{list(rp.keys)} but joined on "
+                    f"{list(node.left_keys)}={list(node.right_keys)}: "
+                    f"matching rows may sit on different devices")
+        elif isinstance(node, Aggregate) and node.keys \
+                and id(node) not in partial_aggs:
+            p = partitioning(node.child, memo)
+            if p.kind == "hash" and not set(p.keys) <= set(node.keys):
+                raise PlanVerificationError(
+                    "partitioning-mismatch", paths[id(node)],
+                    f"aggregate groups on {list(node.keys)} but its input "
+                    f"is hash-placed on {list(p.keys)}: groups would be "
+                    f"split across devices")
 
 
 def plan_segments(plan: PlanNode, cfg=None) -> list:
@@ -545,6 +628,18 @@ def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
                             "count": 1})
             entries.append({"site": "groupby-compaction", "path": path,
                             "count": 1})
+    # hash exchanges pay one counts-sizing fetch (phase 1 of the two-phase
+    # shuffle) and one ok-mask compaction fetch each; broadcast replication
+    # is a pure device_put and pays none.  On a 1-device mesh _exec_exchange
+    # degenerates to the identity and skips both.
+    import jax
+    if len(jax.devices()) > 1:
+        for e in plan_exchanges(plan):
+            if e["kind"] == "hash":
+                entries.append({"site": "exchange-counts-sizing",
+                                "path": e["path"], "count": 1})
+                entries.append({"site": "exchange-compaction",
+                                "path": e["path"], "count": 1})
     return entries
 
 

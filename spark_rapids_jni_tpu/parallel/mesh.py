@@ -86,3 +86,30 @@ def shard_table(table: Table, mesh: Mesh, axis: str = ROW_AXIS) -> Table:
             jax.device_put(c.validity, sharding)
         cols.append(Column(c.dtype, data=data, validity=valid))
     return Table(cols, table.names)
+
+
+def broadcast_table(table: Table, mesh: Mesh) -> Table:
+    """Replicate every column buffer to all mesh devices (the broadcast
+    Exchange: the build side of a broadcast-hash join).
+
+    One fully-replicated ``device_put`` per buffer is the wire move —
+    ``nbytes x (ndev - 1)`` over the interconnect.  The returned Table
+    holds the FIRST device's local replica of each buffer (a committed
+    single-device array), not the multi-device replicated array: mixing
+    committed arrays from different device sets inside one jitted program
+    raises, and downstream per-device compute only ever needs its local
+    copy.  Strings replicate fine — offsets aren't row-sharded here.
+    """
+    sharding = NamedSharding(mesh, P())
+
+    def rep(a):
+        if a is None:
+            return None
+        return jax.device_put(a, sharding).addressable_shards[0].data
+
+    def rep_col(c: Column) -> Column:
+        return Column(c.dtype, data=rep(c.data), validity=rep(c.validity),
+                      offsets=rep(c.offsets),
+                      children=tuple(rep_col(k) for k in c.children))
+
+    return Table([rep_col(c) for c in table.columns], table.names)

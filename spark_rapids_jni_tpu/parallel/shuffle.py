@@ -46,6 +46,7 @@ from ..ops.hash import murmur3_hash
 from ..ops.row_conversion import (RowLayout, _build_planes,
                                   _from_planes)
 from .mesh import ROW_AXIS, axis_size
+from .stringplane import explode_strings, reassemble_strings
 from ..utils import metrics, timeline
 from ..utils.tracing import traced
 
@@ -239,9 +240,13 @@ def partition_counts(table: Table, mesh: Mesh, keys: list,
                                masked=n_valid_rows is not None)
     datas = tuple(c.data for c in table.columns)
     masks = tuple(c.validity for c in table.columns)
-    if n_valid_rows is not None:
-        return np.asarray(fn(datas, masks, jnp.int64(n_valid_rows)))
-    return np.asarray(fn(datas, masks))
+    out = fn(datas, masks, jnp.int64(n_valid_rows)) \
+        if n_valid_rows is not None else fn(datas, masks)
+    # the phase-1 fetch is a DELIBERATE host sync: the counts must reach
+    # the host to become phase 2's static capacity (whitelisted in
+    # engine/verify.SYNC_WHITELIST; the AST lint holds the label honest)
+    metrics.host_sync(label="exchange-counts-sizing")
+    return np.asarray(out)
 
 
 def exchange_planes(planes, dest, row_mask, ndev: int, capacity: int,
@@ -324,7 +329,6 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
     from ..ops.row_conversion import fixed_width_layout
     plan = None
     if any(c.dtype.is_string for c in table.columns):
-        from .stringplane import explode_strings, reassemble_strings
         names0 = table.names or [f"c{i}" for i in range(table.num_columns)]
         keys = [k if isinstance(k, str) else names0[int(k)] for k in keys]
         table, plan = explode_strings(table)
@@ -358,14 +362,13 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
             for dt, d, m in zip(layout.schema, datas_out, masks_out)]
     out = Table(cols, table.names)
     if plan is not None:
-        from .stringplane import reassemble_strings
         out = reassemble_strings(out, plan)
     return out, ok, overflow
 
 
 def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
                              capacity: int | None = None, depth: int = 1,
-                             axis: str = ROW_AXIS):
+                             axis: str = ROW_AXIS, donate: bool = False):
     """Exchange a stream of table chunks with dispatch-ahead overlap.
 
     The engine's double-buffered chunk pipeline applied to the shuffle
@@ -382,7 +385,9 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
     from global counts so ONE compiled shuffle program serves the whole
     stream; with ``capacity=None`` each chunk runs its own counts pass
     (still correct, but differently-filled chunks may compile more than
-    one program).
+    one program).  ``donate=True`` passes through to ``make_shuffle``'s
+    buffer donation: each chunk's send buffers reuse its table's HBM (1x
+    working set) — callers must not touch a chunk after yielding it.
 
     Yields ``(padded Table, ok mask, overflow)`` per chunk, in order.
     """
@@ -391,7 +396,7 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
     for item in chunks:
         tbl, live = item if isinstance(item, tuple) else (item, None)
         out = shuffle_table_padded(tbl, mesh, list(keys), capacity=capacity,
-                                   axis=axis, live=live)
+                                   axis=axis, donate=donate, live=live)
         inflight.append(out)
         # dispatch-ahead depth: how many exchanges sit in the device queue
         # in front of the consumer (the pipeline's high-water mark)

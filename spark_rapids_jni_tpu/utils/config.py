@@ -23,6 +23,8 @@ environment flags read once at import:
 | ``SRJT_TIMELINE_CAP`` | ``16384`` | timeline ring-buffer capacity (events; oldest dropped) |
 | ``SRJT_LOG_FORMAT``   | ``text``| ``json`` emits one JSON object per log line (ts/level/logger/msg + active query) |
 | ``SRJT_VERIFY``       | ``1``   | static plan verification in optimize()/PLAN_EXECUTE (engine/verify.py) |
+| ``SRJT_DIST``         | ``0``   | partitioning-aware distributed planning (Exchange placement rules) |
+| ``SRJT_BROADCAST_ROWS`` | ``100000`` | broadcast-join threshold: estimated build rows at or under this replicate instead of shuffling |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -72,6 +74,8 @@ class Config:
     timeline_cap: int = 16384    # timeline ring-buffer capacity (events)
     log_format: str = "text"     # "text" | "json" (structured log lines)
     verify: bool = True          # static plan verification (engine/verify.py)
+    distribute: bool = False     # Exchange-placement distributed planning
+    broadcast_rows: int = 100_000  # broadcast-join build-size threshold (rows)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -93,6 +97,8 @@ class Config:
             log_format=os.environ.get("SRJT_LOG_FORMAT",
                                       "text").strip().lower(),
             verify=_bool_flag("SRJT_VERIFY", True),
+            distribute=_bool_flag("SRJT_DIST", False),
+            broadcast_rows=_int_flag("SRJT_BROADCAST_ROWS", 100_000),
         )
 
 

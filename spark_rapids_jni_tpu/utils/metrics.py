@@ -109,7 +109,8 @@ def _hist_load(d: dict) -> dict:
 # -- per-query context ------------------------------------------------------
 
 _NODE_FIELDS = ("calls", "wall_s", "rows_in", "rows_out", "chunks",
-                "padded_rows", "host_syncs", "bytes_in", "bytes_out")
+                "padded_rows", "host_syncs", "bytes_in", "bytes_out",
+                "wire_bytes")
 
 
 class QueryMetrics:

@@ -1,0 +1,316 @@
+"""Partitioning-aware distributed planning: Exchange placement, shuffle
+elimination, broadcast-vs-shuffle join selection, and executor parity.
+
+The planner half pins the rewrite contracts (where exchanges land, when
+they're eliminated, how the broadcast threshold decides); the executor half
+pins that both exchange kinds produce exactly the single-device result on
+the 8-device virtual mesh, and that the static exchange census
+(``verify.plan_exchanges``) always matches the executed count — the same
+invariant ci/premerge.sh asserts on the bench smoke artifact.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.engine import (
+    Aggregate, Filter, Join, Scan, col, execute, lit, new_stats, optimize,
+)
+from spark_rapids_jni_tpu.engine.plan import (
+    Exchange, Partitioning, co_partitioned, deserialize, partitioning,
+    topo_nodes,
+)
+from spark_rapids_jni_tpu.engine.verify import (
+    PlanVerificationError, check_partitioning, plan_exchanges, verify,
+)
+from spark_rapids_jni_tpu.utils import config as cfg
+
+N_FACT = 20_000
+N_DIM = 500
+
+
+@pytest.fixture(scope="module")
+def warehouse(tmp_path_factory):
+    root = tmp_path_factory.mktemp("dist")
+    rng = np.random.default_rng(42)
+    k = rng.integers(0, N_DIM, N_FACT)
+    fact = pa.table({
+        "k": pa.array(k, pa.int64()),
+        "v": pa.array(np.round(rng.uniform(0, 100, N_FACT), 3),
+                      pa.float64()),
+    })
+    pq.write_table(fact, root / "fact.parquet", row_group_size=4_000)
+    dk = np.arange(N_DIM, dtype=np.int64)
+    dim = pa.table({"dk": pa.array(dk), "grp": pa.array(dk % 7)})
+    pq.write_table(dim, root / "dim.parquet")
+    return root, fact.to_pandas(), dim.to_pandas()
+
+
+def _join_agg(root, group="grp"):
+    j = Join(Scan(root / "fact.parquet", chunk_bytes=100_000),
+             Scan(root / "dim.parquet"), ("k",), ("dk",), "inner")
+    return Aggregate(j, (group,), (("v", "sum"), ("v", "count")),
+                     ("total", "n"))
+
+
+def _exchanges(plan):
+    return [n for n in topo_nodes(plan) if isinstance(n, Exchange)]
+
+
+def _as_df(table):
+    # to_numpy decodes FLOAT64 bit-pattern storage (dtypes.device_storage)
+    out = pd.DataFrame({n: c.to_numpy()
+                        for n, c in zip(table.names, table.columns)})
+    return out.sort_values(table.names[0]).reset_index(drop=True)
+
+
+# -- plan node -------------------------------------------------------------
+
+def test_exchange_serialize_round_trip():
+    e = Exchange(Scan("/tmp/x.parquet"), ("k", "j"), "hash")
+    r = deserialize(e.serialize())
+    assert isinstance(r, Exchange)
+    assert r.keys == ("k", "j") and r.kind == "hash"
+    assert r.fingerprint() == e.fingerprint()
+    b = deserialize(Exchange(Scan("/tmp/x.parquet"),
+                             kind="broadcast").serialize())
+    assert b.kind == "broadcast" and b.keys == ()
+
+
+def test_exchange_validates_kind_and_keys():
+    with pytest.raises(ValueError):
+        Exchange(Scan("/t"), ("k",), "range")
+    with pytest.raises(ValueError):
+        Exchange(Scan("/t"), (), "hash")
+    with pytest.raises(ValueError):
+        Exchange(Scan("/t"), ("k",), "broadcast")
+
+
+def test_scan_serialization_backward_compatible():
+    """Default scans serialize without the new field, so fingerprints of
+    plans from earlier engine versions are unchanged."""
+    import json
+    blob = json.loads(Scan("/tmp/x.parquet").serialize())
+    assert all("partitioned_by" not in n for n in blob["nodes"])
+    s = deserialize(Scan("/tmp/x.parquet",
+                         partitioned_by=("k",)).serialize())
+    assert s.partitioned_by == ("k",)
+
+
+def test_partitioning_propagation():
+    base = Scan("/tmp/x.parquet")
+    assert partitioning(base) == Partitioning("none", ())
+    h = Exchange(base, ("k",), "hash")
+    assert partitioning(h) == Partitioning("hash", ("k",))
+    # filter preserves placement; a project keeping the key preserves,
+    # one dropping it does not
+    from spark_rapids_jni_tpu.engine.plan import Filter as F, Project
+    assert partitioning(F(h, (">", col("k"), lit(0)))).kind == "hash"
+    assert partitioning(Project(h, ("k", "v"))).keys == ("k",)
+    assert partitioning(Project(h, ("v",))).kind == "none"
+    # aggregate grouping on the placement key preserves it
+    agg = Aggregate(h, ("k",), (("v", "sum"),), ("t",))
+    assert partitioning(agg) == Partitioning("hash", ("k",))
+    # declared scan partitioning
+    s = Scan("/tmp/x.parquet", partitioned_by=("k",))
+    assert partitioning(s) == Partitioning("hash", ("k",))
+
+
+def test_co_partitioned_is_positional():
+    lp = Partitioning("hash", ("k",))
+    rp = Partitioning("hash", ("dk",))
+    assert co_partitioned(lp, rp, ("k",), ("dk",))
+    assert not co_partitioned(lp, rp, ("dk",), ("k",))
+    assert not co_partitioned(Partitioning("none", ()), rp, ("k",), ("dk",))
+
+
+# -- optimizer rules -------------------------------------------------------
+
+def test_broadcast_threshold_picks_join_strategy(warehouse, monkeypatch):
+    root, _, _ = warehouse
+    # dim (500 rows) under the default 100k threshold: broadcast build +
+    # one hash exchange on the aggregate partials
+    opt = optimize(_join_agg(root), distribute=True)
+    kinds = sorted(e.kind for e in _exchanges(opt))
+    assert kinds == ["broadcast", "hash"]
+    join = [n for n in topo_nodes(opt) if isinstance(n, Join)][0]
+    assert isinstance(join.right, Exchange)
+    assert join.right.kind == "broadcast"
+
+    # threshold 0 forces the shuffle join: both sides hash-exchange on the
+    # join keys, plus the partial-agg exchange
+    monkeypatch.setenv("SRJT_BROADCAST_ROWS", "0")
+    cfg.refresh()
+    try:
+        opt = optimize(_join_agg(root), distribute=True)
+        assert sorted(e.kind for e in _exchanges(opt)) == 3 * ["hash"]
+        join = [n for n in topo_nodes(opt) if isinstance(n, Join)][0]
+        assert isinstance(join.left, Exchange)
+        assert join.left.keys == ("k",)
+        assert isinstance(join.right, Exchange)
+        assert join.right.keys == ("dk",)
+    finally:
+        monkeypatch.delenv("SRJT_BROADCAST_ROWS")
+        cfg.refresh()
+
+
+def test_partial_aggregation_pushed_below_exchange(warehouse):
+    """Decomposable aggs split: partial below the hash exchange, combine
+    above — only per-device partial rows cross the wire."""
+    root, _, _ = warehouse
+    opt = optimize(_join_agg(root), distribute=True)
+    combine = opt
+    assert isinstance(combine, Aggregate)
+    assert isinstance(combine.child, Exchange)
+    partial = combine.child.child
+    assert isinstance(partial, Aggregate)
+    assert partial.keys == combine.keys == ("grp",)
+    assert partial.aggs == (("v", "sum"), ("v", "count"))
+    # count partials combine by sum
+    assert combine.aggs == (("total", "sum"), ("n", "sum"))
+
+
+def test_non_decomposable_agg_exchanges_full_input(warehouse):
+    root, _, _ = warehouse
+    j = Join(Scan(root / "fact.parquet"), Scan(root / "dim.parquet"),
+             ("k",), ("dk",), "inner")
+    plan = Aggregate(j, ("grp",), (("v", "mean"),), ("avg_v",))
+    opt = optimize(plan, distribute=True)
+    assert isinstance(opt, Aggregate)
+    assert isinstance(opt.child, Exchange)
+    assert opt.child.kind == "hash"
+    # no partial: the exchange feeds the join output straight in
+    assert not isinstance(opt.child.child, Aggregate)
+
+
+def test_shuffle_elimination_on_co_partitioned_input(warehouse):
+    """The acceptance criterion: scans declared co-partitioned on the join
+    keys plan with ZERO exchanges when the aggregate groups on the
+    partition key — verified and counted statically."""
+    root, _, _ = warehouse
+    j = Join(Scan(root / "fact.parquet", partitioned_by=("k",)),
+             Scan(root / "dim.parquet", partitioned_by=("dk",)),
+             ("k",), ("dk",), "inner")
+    plan = Aggregate(j, ("k",), (("v", "sum"),), ("total",))
+    opt = optimize(plan, distribute=True)
+    assert len(_exchanges(opt)) == 0
+    assert plan_exchanges(opt) == []
+    verify(opt)
+    check_partitioning(opt)
+
+
+def test_redundant_exchange_eliminated(warehouse):
+    """A hand-placed exchange over an identically-placed child folds away;
+    back-to-back exchanges collapse to the outer placement."""
+    root, _, _ = warehouse
+    s = Scan(root / "fact.parquet", partitioned_by=("k",))
+    opt = optimize(Exchange(s, ("k",), "hash"))
+    assert len(_exchanges(opt)) == 0
+    stacked = Exchange(Exchange(Scan(root / "fact.parquet"), ("v",),
+                                "hash"),
+                       ("k",), "hash")
+    opt = optimize(stacked)
+    ex = _exchanges(opt)
+    assert len(ex) == 1 and ex[0].keys == ("k",)
+
+
+# -- verify ----------------------------------------------------------------
+
+def test_infer_exchange_checks_keys(warehouse):
+    root, _, _ = warehouse
+    verify(Exchange(Scan(root / "fact.parquet"), ("k",), "hash"))
+    with pytest.raises(PlanVerificationError, match="unknown-column"):
+        verify(Exchange(Scan(root / "fact.parquet"), ("nope",), "hash"))
+
+
+def test_check_partitioning_flags_mismatched_join(warehouse):
+    root, _, _ = warehouse
+    bad = Join(Exchange(Scan(root / "fact.parquet"), ("v",), "hash"),
+               Exchange(Scan(root / "dim.parquet"), ("dk",), "hash"),
+               ("k",), ("dk",), "inner")
+    with pytest.raises(PlanVerificationError, match="partitioning-mismatch"):
+        check_partitioning(bad)
+
+
+def test_check_partitioning_flags_split_groups(warehouse):
+    root, _, _ = warehouse
+    bad = Aggregate(Exchange(Scan(root / "fact.parquet"), ("v",), "hash"),
+                    ("k",), (("v", "sum"),), ("t",))
+    with pytest.raises(PlanVerificationError, match="partitioning-mismatch"):
+        check_partitioning(bad)
+
+
+def test_check_partitioning_accepts_partial_aggregate(warehouse):
+    """An aggregate feeding an exchange is a partial by construction: its
+    per-device split groups must NOT be flagged."""
+    root, _, _ = warehouse
+    opt = optimize(_join_agg(root), distribute=True)
+    check_partitioning(opt)  # must not raise
+
+
+def test_sync_budget_covers_exchanges(warehouse):
+    from spark_rapids_jni_tpu.engine.verify import sync_budget
+    root, _, _ = warehouse
+    plan = Aggregate(Exchange(Scan(root / "fact.parquet"), ("k",), "hash"),
+                     ("k",), (("v", "sum"),), ("t",))
+    sites = [e["site"] for e in sync_budget(plan)]
+    assert "exchange-counts-sizing" in sites
+    assert "exchange-compaction" in sites
+
+
+# -- executor parity -------------------------------------------------------
+
+def test_distributed_results_match_single_device(warehouse, monkeypatch):
+    root, fact_df, dim_df = warehouse
+    oracle = (fact_df.merge(dim_df, left_on="k", right_on="dk")
+              .groupby("grp")
+              .agg(total=("v", "sum"), n=("v", "count"))
+              .reset_index().sort_values("grp").reset_index(drop=True))
+    oracle["n"] = oracle["n"].astype(np.int64)
+
+    base = _as_df(execute(optimize(_join_agg(root)), new_stats()))
+    pd.testing.assert_frame_equal(base, oracle, check_dtype=False,
+                                  atol=1e-6)
+
+    # broadcast plan
+    opt = optimize(_join_agg(root), distribute=True)
+    stats = new_stats()
+    out = _as_df(execute(opt, stats))
+    pd.testing.assert_frame_equal(out, base, atol=1e-6)
+    assert stats["exchanges"] == len(plan_exchanges(opt)) == 2
+
+    # hash-exchange plan
+    monkeypatch.setenv("SRJT_BROADCAST_ROWS", "0")
+    cfg.refresh()
+    try:
+        opt = optimize(_join_agg(root), distribute=True)
+        stats = new_stats()
+        out = _as_df(execute(opt, stats))
+        pd.testing.assert_frame_equal(out, base, atol=1e-6)
+        assert stats["exchanges"] == len(plan_exchanges(opt)) == 3
+    finally:
+        monkeypatch.delenv("SRJT_BROADCAST_ROWS")
+        cfg.refresh()
+
+
+def test_explain_analyze_renders_exchanges(warehouse):
+    from spark_rapids_jni_tpu.engine.explain import explain_analyze
+    root, _, _ = warehouse
+    rep = explain_analyze(_join_agg(root))
+    assert "Exchange" not in rep.text  # distribution off by default
+    os.environ["SRJT_DIST"] = "1"
+    cfg.refresh()
+    try:
+        rep = explain_analyze(_join_agg(root))
+    finally:
+        del os.environ["SRJT_DIST"]
+        cfg.refresh()
+    assert "Exchange(broadcast)" in rep.text
+    assert "Exchange(hash, keys=['grp'])" in rep.text
+    if rep.summary:  # metrics enabled in this session
+        assert "wire_bytes=" in rep.text
+        assert "exchanges=2" in rep.text

@@ -250,3 +250,84 @@ def test_pipelined_shuffle_matches_serial_and_is_lossless():
         got += list(zip(ot["k"].to_numpy()[m].tolist(),
                         ot["v"].to_numpy()[m].tolist()))
     assert sorted(got) == sorted(zip(k.tolist(), v.tolist()))
+
+
+def _shuffle_chunk_stream(mesh, rng, n=1024, nchunks=4, lo=0, hi=50):
+    from spark_rapids_jni_tpu.parallel import shard_table
+    k = rng.integers(lo, hi, n).astype(np.int64)
+    v = rng.uniform(-1.0, 1.0, n)
+    for i in range(nchunks):
+        s = slice(i * n // nchunks, (i + 1) * n // nchunks)
+        yield shard_table(Table([Column.from_numpy(k[s]),
+                                 Column.from_numpy(v[s])],
+                                ["k", "v"]), mesh)
+
+
+def test_pipelined_shuffle_global_capacity_compiles_one_program():
+    """One-compiled-program contract: a stream exchanged under ONE
+    global capacity adds exactly one make_shuffle entry however many
+    chunks flow; per-chunk sizing (capacity=None) may add more because
+    each chunk's own counts pick its own capacity bucket."""
+    from spark_rapids_jni_tpu.parallel import (make_mesh,
+                                               shuffle_chunks_pipelined)
+    from spark_rapids_jni_tpu.parallel.shuffle import make_shuffle
+    mesh = make_mesh(NDEV)
+    rng = np.random.default_rng(11)
+
+    make_shuffle.cache_clear()
+    before = make_shuffle.cache_info()
+    for _t, _ok, ovf in shuffle_chunks_pipelined(
+            _shuffle_chunk_stream(mesh, rng), mesh, ["k"],
+            capacity=256, depth=2):
+        assert int(ovf) == 0
+    after = make_shuffle.cache_info()
+    assert after.misses - before.misses == 1
+    # the later chunks all hit the single cached program
+    assert after.hits - before.hits == 3
+
+
+def test_pipelined_shuffle_depth_zero_is_serial():
+    """depth=0 degenerates to the serial exchange-then-merge loop: at most
+    one exchange is ever in flight (the dispatch-ahead gauge high-water
+    stays at 1), while depth=2 keeps more in front of the consumer."""
+    from spark_rapids_jni_tpu.parallel import (make_mesh,
+                                               shuffle_chunks_pipelined)
+    from spark_rapids_jni_tpu.utils import metrics
+    mesh = make_mesh(NDEV)
+    rng = np.random.default_rng(12)
+
+    metrics.reset("parallel.shuffle.dispatch_ahead")
+    list(shuffle_chunks_pipelined(_shuffle_chunk_stream(mesh, rng), mesh,
+                                  ["k"], capacity=256, depth=0))
+    assert metrics.gauges_snapshot(
+        "parallel.shuffle.dispatch_ahead")[
+        "parallel.shuffle.dispatch_ahead"] == 1
+
+    metrics.reset("parallel.shuffle.dispatch_ahead")
+    list(shuffle_chunks_pipelined(_shuffle_chunk_stream(mesh, rng), mesh,
+                                  ["k"], capacity=256, depth=2))
+    assert metrics.gauges_snapshot(
+        "parallel.shuffle.dispatch_ahead")[
+        "parallel.shuffle.dispatch_ahead"] == 3
+
+
+def test_pipelined_shuffle_donate_matches_undonated():
+    """donate=True plumbs through to the compiled shuffle (send buffers
+    reuse the chunk's memory); per-chunk results are identical to the
+    undonated stream."""
+    from spark_rapids_jni_tpu.parallel import (make_mesh,
+                                               shuffle_chunks_pipelined)
+    mesh = make_mesh(NDEV)
+    plain = list(shuffle_chunks_pipelined(
+        _shuffle_chunk_stream(mesh, np.random.default_rng(13)), mesh,
+        ["k"], capacity=256, depth=1))
+    donated = list(shuffle_chunks_pipelined(
+        _shuffle_chunk_stream(mesh, np.random.default_rng(13)), mesh,
+        ["k"], capacity=256, depth=1, donate=True))
+    assert len(plain) == len(donated)
+    for (pt, pok, povf), (dt, dok, dovf) in zip(plain, donated):
+        assert int(povf) == int(dovf) == 0
+        np.testing.assert_array_equal(np.asarray(pok), np.asarray(dok))
+        for cp, cd in zip(pt.columns, dt.columns):
+            np.testing.assert_array_equal(np.asarray(cp.data),
+                                          np.asarray(cd.data))
