@@ -382,14 +382,19 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
 
     plan = None
     keys = list(node.keys)
+    key_specs = None
     if any(c.dtype.is_string for c in table.columns):
-        # strings cross the exchange in padded-bucket form; exploded key
-        # words hash consistently for every row of THIS exchange (equal
-        # strings explode identically at one width)
+        # strings cross the exchange in padded-bucket form, exploded ONCE
+        # globally so every chunk shares one layout (and one compiled
+        # program).  Placement hashes the ORIGINAL UTF-8 bytes (Spark
+        # UTF8String murmur3, reconstructed on device from the exploded
+        # words via "string" key specs) — width-independent and identical
+        # to Scan.partitioned_by / shuffle_table_padded placement, so
+        # co-partitioning claims over string keys stay meaningful
         from ..parallel.stringplane import (explode_strings,
                                             reassemble_strings)
         table, plan = explode_strings(table)
-        keys = plan.exploded_keys(keys)
+        key_specs = sh.key_specs_for(table, keys, plan)
 
     mesh = make_mesh(ndev)
     rows = table.num_rows
@@ -404,13 +409,19 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
 
     capacity = None
     if nchunks > 1:
-        # phase 1 once, globally: every chunk's per-(src, dest) count is
-        # bounded by the whole table's, so one counts sync sizes one
-        # compiled shuffle program for the entire stream
+        # phase 1 once, globally, so one counts sync sizes one compiled
+        # shuffle program for the entire stream.  A chunk's contiguous
+        # shard can straddle one whole-table shard boundary (chunk shards
+        # are never longer than table shards), so its per-(src, dest)
+        # count is bounded by the SUM of two adjacent whole-table pair
+        # counts — size the shared capacity at 2x the global max (one
+        # power-of-two bucket up), which that bound can never exceed
         padded, _ = pad_to_multiple(table, ndev)
         counts = sh.partition_counts(shard_table(padded, mesh), mesh, keys,
-                                     n_valid_rows=rows)
-        capacity = sh.cap_bucket(int(counts.max()))
+                                     n_valid_rows=rows,
+                                     key_specs=key_specs)
+        capacity = sh.cap_bucket(2 * int(counts.max()))
+        metrics.host_sync(key=id(node), label="exchange-counts-sizing")
 
     def chunk_stream():
         for i in range(nchunks):
@@ -421,7 +432,7 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
     with timeline.span("engine.exchange.hash", {"chunks": int(nchunks)}):
         outs = list(sh.shuffle_chunks_pipelined(
             chunk_stream(), mesh, keys, capacity=capacity,
-            depth=max(1, ctx.prefetch)))
+            depth=max(1, ctx.prefetch), key_specs=key_specs))
 
     # one deliberate barrier: the ok masks reach the host and the padded
     # receive slots compact to live rows (distributed.py's compact idiom)

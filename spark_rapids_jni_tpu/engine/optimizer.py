@@ -26,9 +26,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
-                   Project, Scan, Sort, TopK, co_partitioned, expr_columns,
-                   partitioning, rebuild, topo_nodes)
+from .plan import (ORDER_SENSITIVE_AGGS, Aggregate, Exchange, Filter, Join,
+                   Limit, PlanNode, Project, Scan, Sort, TopK,
+                   co_partitioned, expr_columns, partitioning, rebuild,
+                   topo_nodes)
 
 #: comparisons a scan predicate hint can absorb (col vs literal)
 _RANGE_OPS = {">=", "<=", ">", "<", "=="}
@@ -424,7 +425,10 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
       a subset of the group keys.  Decomposable aggs split into a partial
       BELOW the exchange and a combine above it, so only per-device
       partial rows cross the wire; non-decomposable aggs exchange the full
-      input on the group keys.
+      input on the group keys.  Order-sensitive aggs
+      (first/last/collect_list) never distribute: the hash exchange does
+      not preserve row order, so the whole subtree stays the original
+      single stream and matches single-device results exactly.
     """
     if id(node) in memo:
         return memo[id(node)]
@@ -456,11 +460,20 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
                         and tuple(rp.keys) == tuple(out.right_keys)):
                     right = Exchange(right, out.right_keys, "hash")
                 out = rebuild(out, left=left, right=right)
-    elif isinstance(out, Aggregate) and out.keys:
+    elif isinstance(out, Aggregate):
         from .executor import _STREAM_COMBINE
         p = partitioning(out.child, pmemo)
-        if p.kind == "broadcast" or (p.kind == "hash"
-                                     and set(p.keys) <= set(out.keys)):
+        if any(op in ORDER_SENSITIVE_AGGS for _, op in out.aggs):
+            # first/last/collect_list results depend on input row ORDER,
+            # which _exec_exchange's hash kind deliberately does not
+            # preserve (order-insensitive consumers only) — revert to the
+            # pre-pass subtree so no planner-placed exchange can silently
+            # reorder rows anywhere below this aggregate
+            out = node
+        elif not out.keys:
+            pass  # ungrouped: one global group, no placement to satisfy
+        elif p.kind == "broadcast" or (p.kind == "hash"
+                                       and set(p.keys) <= set(out.keys)):
             pass  # every group's rows already share a device
         elif all(op in _STREAM_COMBINE for _, op in out.aggs):
             # partial below the exchange: per-device partials are what

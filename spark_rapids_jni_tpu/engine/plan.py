@@ -41,6 +41,11 @@ EXCHANGE_KINDS = ("hash", "broadcast")
 AGG_OPS = ("sum", "min", "max", "mean", "count", "count_all", "var", "std",
            "sumsq", "fsum", "first", "last", "collect_list")
 
+#: aggregate ops whose result depends on input row ORDER — a hash Exchange
+#: does not preserve order, so the distributed planner never places one
+#: beneath an aggregate using these
+ORDER_SENSITIVE_AGGS = ("first", "last", "collect_list")
+
 
 # -- expression helpers ----------------------------------------------------
 

@@ -601,14 +601,21 @@ def _statically_eligible(seg, resolver: SchemaResolver) -> bool:
 
 
 def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
-                cfg=None) -> list:
+                cfg=None, ndev: Optional[int] = None) -> list:
     """Static model of the deliberate host syncs an optimized plan pays on
     the fused paths — one entry per sync, ``site`` naming the whitelisted
     call site in engine/segment.py.  Mirrors the runtime
     ``engine.host_sync`` counter: a map segment pays one boundary
     compaction, an agg segment one groupby compaction, a streamed agg
     segment a combine-sizing fetch plus the compaction — however many
-    chunks stream through."""
+    chunks stream through.
+
+    ``ndev`` is the mesh size the exchange entries assume (default: the
+    runtime ``len(jax.devices())`` at call time — pass it explicitly to
+    model a target mesh from a different host).  The two per-hash-exchange
+    entries are an UPPER bound: ``_exec_exchange`` also early-outs on an
+    EMPTY input table, paying zero syncs where this model charges two.
+    """
     resolver = resolver or SchemaResolver()
     entries: list = []
     for s in plan_segments(plan, cfg):
@@ -632,8 +639,10 @@ def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
     # shuffle) and one ok-mask compaction fetch each; broadcast replication
     # is a pure device_put and pays none.  On a 1-device mesh _exec_exchange
     # degenerates to the identity and skips both.
-    import jax
-    if len(jax.devices()) > 1:
+    if ndev is None:
+        import jax
+        ndev = len(jax.devices())
+    if ndev > 1:
         for e in plan_exchanges(plan):
             if e["kind"] == "hash":
                 entries.append({"site": "exchange-counts-sizing",
@@ -643,12 +652,12 @@ def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
     return entries
 
 
-def check_sync_budget(plans, cfg=None) -> tuple:
+def check_sync_budget(plans, cfg=None, ndev: Optional[int] = None) -> tuple:
     """``(entries, violations)`` over a set of optimized plans: every
     entry with a nonzero count must name a whitelisted sync site."""
     entries: list = []
     for p in plans:
-        entries += sync_budget(p, cfg=cfg)
+        entries += sync_budget(p, cfg=cfg, ndev=ndev)
     bad = [e for e in entries
            if e["count"] and e["site"] not in SYNC_WHITELIST]
     return entries, bad

@@ -232,7 +232,13 @@ def make_partition_counts(mesh: Mesh, key_specs: tuple,
 def partition_counts(table: Table, mesh: Mesh, keys: list,
                      axis: str = ROW_AXIS, n_valid_rows=None,
                      key_specs: tuple | None = None):
-    """Host wrapper over ``make_partition_counts`` for a sharded table."""
+    """Host wrapper over ``make_partition_counts`` for a sharded table.
+
+    The returned array has reached the host — a deliberate sync the engine
+    Exchange paths label via ``metrics.host_sync("exchange-counts-sizing")``
+    at THEIR call sites (here would also tag the distributed.py/spill.py
+    callers, whose syncs ``verify.sync_budget`` does not model).
+    """
     import numpy as np
     if key_specs is None:
         key_specs = key_specs_for(table, keys, None)
@@ -242,10 +248,6 @@ def partition_counts(table: Table, mesh: Mesh, keys: list,
     masks = tuple(c.validity for c in table.columns)
     out = fn(datas, masks, jnp.int64(n_valid_rows)) \
         if n_valid_rows is not None else fn(datas, masks)
-    # the phase-1 fetch is a DELIBERATE host sync: the counts must reach
-    # the host to become phase 2's static capacity (whitelisted in
-    # engine/verify.SYNC_WHITELIST; the AST lint holds the label honest)
-    metrics.host_sync(label="exchange-counts-sizing")
     return np.asarray(out)
 
 
@@ -309,7 +311,7 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_specs: tuple,
 def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                          capacity: int | None = None,
                          axis: str = ROW_AXIS, donate: bool = False,
-                         live=None):
+                         live=None, key_specs: tuple | None = None):
     """Shuffle a row-sharded table by key hash.
 
     Returns (padded Table [ndev * ndev * capacity global rows], row mask
@@ -325,6 +327,11 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
     UTF8String murmur3 over the original bytes (reconstructed on device
     from the exploded words — ``partition_ids_specs``), so partition
     placement interoperates with Spark's HashPartitioning wire-exactly.
+
+    ``key_specs``: pre-computed ``key_specs_for`` result for callers whose
+    table is ALREADY exploded (the engine exchange explodes once globally
+    so every chunk shares one layout) — overrides the local computation so
+    string keys still hash Spark-exactly.
     """
     from ..ops.row_conversion import fixed_width_layout
     plan = None
@@ -336,12 +343,17 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
         table = shard_table(table, mesh, axis)  # strings couldn't shard before
     layout = fixed_width_layout(table.dtypes())
     ndev = axis_size(mesh, axis)
-    key_specs = key_specs_for(table, keys, plan)
+    if key_specs is None:
+        key_specs = key_specs_for(table, keys, plan)
     if capacity is None:
-        # two-phase exchange: counts pass sizes the payload pass exactly
+        # two-phase exchange: counts pass sizes the payload pass exactly.
+        # The counts fetch is a DELIBERATE host sync (they must reach the
+        # host to become phase 2's static capacity) — whitelisted in
+        # engine/verify.SYNC_WHITELIST; the AST lint holds the label honest
         capacity = cap_bucket(
             int(partition_counts(table, mesh, list(keys), axis,
                                  key_specs=key_specs).max()))
+        metrics.host_sync(label="exchange-counts-sizing")
     fn = make_shuffle(mesh, layout, key_specs, capacity, axis, donate)
     # exchange observability: every slot of the padded all_to_all crosses
     # the interconnect whether live or not, so slots x row_size IS the
@@ -368,7 +380,8 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
 
 def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
                              capacity: int | None = None, depth: int = 1,
-                             axis: str = ROW_AXIS, donate: bool = False):
+                             axis: str = ROW_AXIS, donate: bool = False,
+                             key_specs: tuple | None = None):
     """Exchange a stream of table chunks with dispatch-ahead overlap.
 
     The engine's double-buffered chunk pipeline applied to the shuffle
@@ -388,6 +401,8 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
     one program).  ``donate=True`` passes through to ``make_shuffle``'s
     buffer donation: each chunk's send buffers reuse its table's HBM (1x
     working set) — callers must not touch a chunk after yielding it.
+    ``key_specs`` passes through to ``shuffle_table_padded`` for streams of
+    already-exploded chunks (Spark-exact string-key placement).
 
     Yields ``(padded Table, ok mask, overflow)`` per chunk, in order.
     """
@@ -396,7 +411,8 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
     for item in chunks:
         tbl, live = item if isinstance(item, tuple) else (item, None)
         out = shuffle_table_padded(tbl, mesh, list(keys), capacity=capacity,
-                                   axis=axis, donate=donate, live=live)
+                                   axis=axis, donate=donate, live=live,
+                                   key_specs=key_specs)
         inflight.append(out)
         # dispatch-ahead depth: how many exchanges sit in the device queue
         # in front of the consumer (the pipeline's high-water mark)

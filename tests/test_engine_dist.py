@@ -203,6 +203,34 @@ def test_shuffle_elimination_on_co_partitioned_input(warehouse):
     check_partitioning(opt)
 
 
+def test_order_sensitive_agg_stays_single_stream(warehouse):
+    """first/last/collect_list results depend on input row order, which
+    the hash exchange does not preserve — the planner must leave their
+    whole subtree as the original single stream (no Exchange anywhere),
+    so distributed results stay identical to single-device execution."""
+    root, _, _ = warehouse
+    j = Join(Scan(root / "fact.parquet"), Scan(root / "dim.parquet"),
+             ("k",), ("dk",), "inner")
+    for op in ("first", "last", "collect_list"):
+        plan = Aggregate(j, ("grp",), (("v", op),), ("x",))
+        opt = optimize(plan, distribute=True)
+        assert _exchanges(opt) == [], op
+    # mixed with decomposable ops: still order-sensitive, still no split
+    mixed = Aggregate(j, ("grp",), (("v", "sum"), ("v", "first")),
+                      ("total", "f"))
+    opt = optimize(mixed, distribute=True)
+    assert _exchanges(opt) == []
+    assert isinstance(opt, Aggregate) and opt.aggs == mixed.aggs
+    # ungrouped order-sensitive aggs must not see exchanges below either
+    ungrouped = Aggregate(j, (), (("v", "first"),), ("f",))
+    assert _exchanges(optimize(ungrouped, distribute=True)) == []
+    # parity: the distributed plan IS the single-stream plan
+    plan = Aggregate(j, ("grp",), (("v", "first"),), ("f",))
+    base = _as_df(execute(optimize(plan), new_stats()))
+    out = _as_df(execute(optimize(plan, distribute=True), new_stats()))
+    pd.testing.assert_frame_equal(out, base)
+
+
 def test_redundant_exchange_eliminated(warehouse):
     """A hand-placed exchange over an identically-placed child folds away;
     back-to-back exchanges collapse to the outer placement."""
@@ -295,6 +323,84 @@ def test_distributed_results_match_single_device(warehouse, monkeypatch):
     finally:
         monkeypatch.delenv("SRJT_BROADCAST_ROWS")
         cfg.refresh()
+
+
+def test_multi_chunk_exchange_survives_boundary_skew(tmp_path, monkeypatch):
+    """A chunk's contiguous shard can straddle a whole-table shard
+    boundary, so its per-(src, dest) count can reach the SUM of two global
+    pair counts: 128 same-destination rows centered on the first table
+    shard boundary split 64/64 across the global (src, dest) pairs but all
+    land in one chunk shard — a capacity sized from the global max alone
+    overflows on this valid input."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.engine import executor as ex
+    from spark_rapids_jni_tpu.parallel.shuffle import partition_ids
+
+    n, chunk_rows = 1536, 1024        # 2 chunks; table shard = 192 rows
+    pool = np.arange(4096, dtype=np.int64)
+    dests = np.asarray(partition_ids(
+        Table([Column.from_numpy(pool)], ["k"]), 8))
+    hot = pool[dests == dests[0]]     # keys all placed on one destination
+    cold = pool[dests != dests[0]]
+    k = cold[np.arange(n) % len(cold)]
+    # hot rows at [128, 256): inside chunk 0's shard 1 ([128, 256) at
+    # chunk-shard size 128) but split 64/64 by the table boundary at 192
+    k[128:256] = hot[np.arange(128) % len(hot)]
+    v = np.arange(n, dtype=np.int64)
+    pq.write_table(pa.table({"k": pa.array(k), "v": pa.array(v)}),
+                   tmp_path / "skew.parquet")
+    monkeypatch.setattr(ex, "_EXCHANGE_CHUNK_ROWS", chunk_rows)
+    plan = Aggregate(Exchange(Scan(tmp_path / "skew.parquet"), ("k",),
+                              "hash"),
+                     ("k",), (("v", "sum"),), ("t",))
+    stats = new_stats()
+    out = _as_df(execute(optimize(plan), stats))
+    assert stats["exchanges"] == 1
+    oracle = (pd.DataFrame({"k": k, "v": v}).groupby("k")
+              .agg(t=("v", "sum")).reset_index()
+              .sort_values("k").reset_index(drop=True))
+    pd.testing.assert_frame_equal(out, oracle, check_dtype=False)
+
+
+def test_string_key_exchange_places_spark_exact(tmp_path):
+    """String keys hash their ORIGINAL UTF-8 bytes (Spark UTF8String
+    murmur3) — invariant to the width the exchange explodes at, so
+    placement matches Scan.partitioned_by's documented contract and
+    co-partitioning claims over string keys stay meaningful."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.parallel import shuffle as sh
+    from spark_rapids_jni_tpu.parallel.stringplane import explode_strings
+
+    vals = ["a", "bb", "ccc", "", "delta", "echo-echo",
+            "a-much-longer-string-key"] * 3
+    t = Table([Column.from_pylist(vals)], ["s"])
+    ids = []
+    for overrides in (None, {"s": 64}):
+        exploded, plan = explode_strings(t, width_overrides=overrides)
+        specs = sh.key_specs_for(exploded, ["s"], plan)
+        assert specs[0][0] == "string"
+        ids.append(np.asarray(
+            sh.partition_ids_specs(exploded.columns, specs, 8)))
+    np.testing.assert_array_equal(ids[0], ids[1])
+
+    # end-to-end: a string-keyed hash exchange executes and reassembles
+    words = np.array(["alpha", "bravo", "charlie", "delta", "echo"])
+    s = words[np.arange(400) % 5]
+    v = np.arange(400, dtype=np.int64)
+    pq.write_table(pa.table({"s": pa.array(s), "v": pa.array(v)}),
+                   tmp_path / "s.parquet")
+    plan = Aggregate(Exchange(Scan(tmp_path / "s.parquet"), ("s",), "hash"),
+                     ("s",), (("v", "sum"),), ("t",))
+    stats = new_stats()
+    out = execute(optimize(plan), stats)
+    assert stats["exchanges"] == 1
+    got = (pd.DataFrame({"s": out.columns[0].to_pylist(),
+                         "t": out.columns[1].to_numpy()})
+           .sort_values("s").reset_index(drop=True))
+    oracle = (pd.DataFrame({"s": s, "v": v}).groupby("s")
+              .agg(t=("v", "sum")).reset_index()
+              .sort_values("s").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, oracle, check_dtype=False)
 
 
 def test_explain_analyze_renders_exchanges(warehouse):
