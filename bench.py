@@ -1018,6 +1018,36 @@ refresh()
 optB = optimize(mkplan(), distribute=True)
 exB = plan_exchanges(optB)
 tB, outB, stB = timed(optB)
+
+# per-device exchange attribution of the hash-exchange run just timed:
+# the per-(src, dest) wire matrix must sum EXACTLY to the query's
+# engine.exchange.wire_bytes counter (the invariant premerge asserts)
+from spark_rapids_jni_tpu.utils import metrics as _m
+dev_attrib = {{"matrix_matches": None, "skew": None, "max_dev_rows": None,
+               "wire_matrix_sum": None, "wire_bytes_counter": None,
+               "exchange_nodes": 0, "explain_skew_rendered": None}}
+if _m.enabled():
+    summ = _m.recent_summaries()[-1]
+    ex_nodes = [n for n in summ["nodes"] if n.get("wire_matrix")]
+    mat_sum = sum(sum(r) for n in ex_nodes for r in n["wire_matrix"])
+    ctr = summ["counters"].get("engine.exchange.wire_bytes", 0)
+    dev_attrib.update(
+        exchange_nodes=len(ex_nodes),
+        wire_matrix_sum=mat_sum, wire_bytes_counter=ctr,
+        matrix_matches=bool(ex_nodes) and mat_sum == ctr,
+        skew=max(n.get("skew") or 0.0 for n in ex_nodes)
+        if ex_nodes else None,
+        max_dev_rows=max(n.get("max_dev_rows") or 0 for n in ex_nodes)
+        if ex_nodes else None)
+    # and the rendered EXPLAIN ANALYZE must carry the skew columns on the
+    # same forced-exchange plan shape (SRJT_DIST routes optimize())
+    os.environ["SRJT_DIST"] = "1"
+    refresh()
+    from spark_rapids_jni_tpu.engine.explain import explain_analyze
+    rep = explain_analyze(mkplan())
+    dev_attrib["explain_skew_rendered"] = "skew=" in rep.text
+    del os.environ["SRJT_DIST"]
+
 del os.environ["SRJT_BROADCAST_ROWS"]
 refresh()
 
@@ -1069,6 +1099,7 @@ print(json.dumps({{
                    "copartitioned_static": len(exD),
                    "copartitioned_executed": stD["exchanges"]}},
     "smj_rows": smj.num_rows,
+    "device_attrib": dev_attrib,
     "results_match": bool(norm(outA) == norm(base)
                           and norm(outB) == norm(base))}}))
 """
@@ -1097,6 +1128,15 @@ def smoke():
     paths end-to-end, correctness-only (no timing assertions) — wired into
     ci/premerge.sh so perf-path exceptions fail fast in tier-1 budget."""
     import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
+    # profile store for the whole smoke run, BEFORE any bench executes: the
+    # dist bench's subprocess inherits the env, so its exchange profiles
+    # land in the same ring and the sixth line can report their skew
+    if not os.environ.get("SRJT_PROFILE_DIR"):
+        import tempfile
+        os.environ["SRJT_PROFILE_DIR"] = tempfile.mkdtemp(
+            prefix="srjt-smoke-profiles-")
+        from spark_rapids_jni_tpu.utils.config import refresh
+        refresh()
     res = bench_engine_pipeline(n=20_000, chunk_bytes=48_000, smoke=True)
     ok = bool(res and res["results_match"] and res["fused_streamed"]
               and res["chunks"] > 1)
@@ -1180,16 +1220,22 @@ def smoke():
     # census must equal the executed count, and the co-partitioned plan
     # must carry ZERO exchanges (premerge asserts all three on this line)
     dres = bench_engine_dist(n_fact=60_000, n_dim=500, smoke=True)
+    dattr = (dres or {}).get("device_attrib") or {}
     dok = bool(dres and dres["results_match"]
                and dres["exchanges"]["broadcast_static"]
                == dres["exchanges"]["broadcast_executed"]
                and dres["exchanges"]["exchange_static"]
                == dres["exchanges"]["exchange_executed"]
                and dres["exchanges"]["copartitioned_static"]
-               == dres["exchanges"]["copartitioned_executed"] == 0)
+               == dres["exchanges"]["copartitioned_executed"] == 0
+               # per-device attribution invariants (False fails; None =
+               # metrics off, nothing to check)
+               and dattr.get("matrix_matches") is not False
+               and dattr.get("explain_skew_rendered") is not False)
     print(json.dumps({"metric": "engine_dist_smoke",
                       "ok": dok,
                       "exchanges": dres["exchanges"] if dres else None,
+                      "device_attrib": dattr or None,
                       "latency_ms": {} if not dres else {
                           "broadcast": round(dres["broadcast_s"] * 1e3, 3),
                           "exchange": round(dres["exchange_s"] * 1e3, 3),
@@ -1204,7 +1250,18 @@ def smoke():
                           if dres["ratios"]["broadcast_vs_exchange"]
                           else None,
                       }}))
-    return 0 if (ok and jok and mok and tok and dok) else 1
+    # sixth line: the query-profile store — every query above (this
+    # process AND the dist subprocess, via the inherited env) persisted a
+    # profile; the store summary must carry the dist exchanges' skew
+    from spark_rapids_jni_tpu.utils import profile
+    psumm = profile.store_summary()
+    pok = (not profile.enabled()) or (
+        psumm["profiles"] > 0 and psumm["top_exchange_skew"] is not None)
+    print(json.dumps({"metric": "profile_store",
+                      "ok": pok,
+                      "enabled": profile.enabled(),
+                      **psumm}))
+    return 0 if (ok and jok and mok and tok and dok and pok) else 1
 
 
 def main():

@@ -20,7 +20,16 @@ never touched); ``_gate`` is a separate, freely retunable table.
 
 Report-only by default: always prints the table and a JSON summary line,
 exits 0.  ``--enforce`` makes regressions (and missing gated keys) exit
-non-zero — premerge runs report-only while tolerances are tuned.
+non-zero; ``--enforce-keys a,b,c`` narrows enforcement to an allowlist so
+soaked keys gate hard while newer keys stay report-only — the flip is
+per-key, not all-or-nothing.
+
+``--profiles DIR`` additionally aggregates the query-profile store
+(utils/profile.py) into profile-derived keys — ``profile.exchange.skew``
+(worst skew across stored profiles), ``profile.exchange.straggler_share``,
+``profile.chunk_latency.p99`` — so the gate can catch *why* a headline
+number regressed (the exchange skewed, the latency tail grew), not just
+that it did.  Pure JSON reads; no engine import.
 """
 
 from __future__ import annotations
@@ -71,6 +80,41 @@ def parse_artifact(text: str) -> dict:
     return flat
 
 
+def profile_keys(profiles_dir: str) -> dict:
+    """Aggregate the profile store into gateable dotted keys.
+
+    Worst-case aggregation across every stored profile (a gate should
+    catch the worst run in the artifact, not the average): max exchange
+    skew / straggler share, max chunk-latency p99.  Unreadable files are
+    skipped — a torn profile must not fail the gate by itself."""
+    out: dict[str, float] = {}
+    try:
+        names = sorted(os.listdir(profiles_dir))
+    except OSError:
+        return out
+
+    def fold(key, v):
+        if v is not None and (key not in out or v > out[key]):
+            out[key] = float(v)
+
+    for name in names:
+        if not (name.startswith("profile-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(profiles_dir, name)) as f:
+                prof = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for ex in prof.get("exchanges", ()):
+            fold("profile.exchange.skew", ex.get("skew"))
+            fold("profile.exchange.straggler_share",
+                 ex.get("straggler_share"))
+        h = prof.get("histograms", {}).get("engine.stream.chunk_latency_s")
+        if h:
+            fold("profile.chunk_latency.p99", h.get("p99"))
+    return out
+
+
 def load_gate(path: str) -> tuple[dict, float]:
     with open(path) as f:
         pins = json.load(f)
@@ -108,14 +152,25 @@ def classify(value, spec: dict, default_tol: float) -> dict:
 
 
 def run_gate(artifact_text: str, baselines_path: str,
-             tolerance: float | None = None) -> dict:
+             tolerance: float | None = None,
+             enforce_keys: list | None = None,
+             profiles_dir: str | None = None) -> dict:
     flat = parse_artifact(artifact_text)
+    if profiles_dir:
+        flat.update(profile_keys(profiles_dir))
     specs, default_tol = load_gate(baselines_path)
     if tolerance is not None:
         default_tol = tolerance
     rows = {key: classify(flat.get(key), spec, default_tol)
             for key, spec in specs.items()}
     statuses = [r["status"] for r in rows.values()]
+    # failures that count under --enforce: all bad rows, or just the
+    # allowlisted subset when --enforce-keys narrows the flip
+    bad = [k for k, r in rows.items()
+           if r["status"] in ("regression", "missing")]
+    if enforce_keys is not None:
+        allow = set(enforce_keys)
+        bad = [k for k in bad if k in allow]
     return {
         "rows": rows,
         "checked": len(rows),
@@ -123,6 +178,7 @@ def run_gate(artifact_text: str, baselines_path: str,
         "improved": statuses.count("improved"),
         "regressions": statuses.count("regression"),
         "missing": statuses.count("missing"),
+        "enforced_failures": sorted(bad),
     }
 
 
@@ -152,6 +208,13 @@ def main(argv=None) -> int:
     ap.add_argument("--tolerance", type=float, default=None,
                     help="override _gate.tolerance_default for keys "
                          "without a per-key tolerance")
+    ap.add_argument("--profiles", default=None, metavar="DIR",
+                    help="query-profile store dir; aggregates "
+                         "profile.* keys into the artifact")
+    ap.add_argument("--enforce-keys", default=None, metavar="K1,K2",
+                    help="comma allowlist: with --enforce, only these "
+                         "keys' regressions fail the gate (all keys are "
+                         "still reported)")
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--report-only", action="store_true", default=True,
                       help="print the report, always exit 0 (default)")
@@ -165,7 +228,13 @@ def main(argv=None) -> int:
         with open(args.artifact) as f:
             text = f.read()
 
-    summary = run_gate(text, args.baselines, args.tolerance)
+    enforce_keys = None
+    if args.enforce_keys is not None:
+        enforce_keys = [k.strip() for k in args.enforce_keys.split(",")
+                        if k.strip()]
+    summary = run_gate(text, args.baselines, args.tolerance,
+                       enforce_keys=enforce_keys,
+                       profiles_dir=args.profiles)
     print(render(summary))
     print(json.dumps({"metric": "bench_gate",
                       "enforced": bool(args.enforce),
@@ -173,8 +242,9 @@ def main(argv=None) -> int:
                       "ok": summary["ok"],
                       "improved": summary["improved"],
                       "regressions": summary["regressions"],
-                      "missing": summary["missing"]}))
-    if args.enforce and (summary["regressions"] or summary["missing"]):
+                      "missing": summary["missing"],
+                      "enforced_failures": summary["enforced_failures"]}))
+    if args.enforce and summary["enforced_failures"]:
         return 1
     return 0
 

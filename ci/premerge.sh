@@ -38,8 +38,10 @@ python -m pytest tests/ -q
 # timeline line must point at a loadable Chrome trace-event JSON
 # (docs/OBSERVABILITY.md).
 mkdir -p target
+rm -rf target/smoke-profiles
 SMOKE_OUT=$(JAX_PLATFORMS=cpu SRJT_TRACE=1 SRJT_METRICS=1 \
     SRJT_TIMELINE=1 SRJT_TIMELINE_OUT=target/smoke-timeline.json \
+    SRJT_PROFILE_DIR=target/smoke-profiles \
     python bench.py --smoke)
 echo "$SMOKE_OUT"
 echo "$SMOKE_OUT" > target/smoke-artifact.json
@@ -72,13 +74,38 @@ assert ex["copartitioned_static"] == ex["copartitioned_executed"] == 0, ex
 print("engine dist: exchanges static==executed (%d broadcast-plan, %d "
       "exchange-plan), co-partitioned 0" % (ex["broadcast_executed"],
                                             ex["exchange_executed"]))
+# per-device exchange attribution (docs/OBSERVABILITY.md): the per-(src,
+# dest) wire matrix must sum to engine.exchange.wire_bytes, and the dist
+# smoke plan must render skew in EXPLAIN ANALYZE
+da = dist[0].get("device_attrib") or {}
+assert da.get("matrix_matches") is True, \
+    "exchange wire matrix != wire_bytes counter: %r" % da
+assert da.get("explain_skew_rendered") is True, da
+assert da.get("skew") is not None and da["skew"] >= 1.0, da
+print("device attrib: %d exchange nodes, matrix sum %d == counter, "
+      "skew %.2f" % (da["exchange_nodes"], da["wire_matrix_sum"],
+                     da["skew"]))
+prof = [s for s in snaps if s.get("metric") == "profile_store"]
+assert prof, "bench.py --smoke emitted no profile_store line"
+assert prof[0]["enabled"] and prof[0]["ok"], \
+    "profile_store line not ok: %r" % prof[0]
+assert prof[0]["profiles"] > 0, prof[0]
+assert prof[0]["top_exchange_skew"] is not None, \
+    "no exchange skew reached the profile store"
+print("profile store: %d profiles at %s, top skew %s" %
+      (prof[0]["profiles"], prof[0]["dir"], prof[0]["top_exchange_skew"]))
 '
 
-# bench regression gate, report-only while tolerances are tuned: diffs the
-# smoke artifact against the _gate references in BENCH_BASELINES.json
-# (full-bench keys show as "missing" here, which report-only tolerates;
-# nightly runs the gate over the full artifact)
-python ci/bench_gate.py --artifact target/smoke-artifact.json --report-only
+# bench regression gate: ENFORCED for the smoke-line ratio keys that have
+# soaked since PR 5 (--enforce-keys allowlist — a regression or a silently
+# dropped key among them fails premerge); every other enrolled key,
+# including the PR-8 dist ratios and the new profile-derived keys, stays
+# report-only in the same run.  --profiles folds the query-profile store
+# into the artifact (profile.exchange.skew, profile.chunk_latency.p99).
+python ci/bench_gate.py --artifact target/smoke-artifact.json \
+    --profiles target/smoke-profiles \
+    --enforce \
+    --enforce-keys engine_pipeline_smoke.ratios.fused_vs_interp,engine_join_smoke.ratios.cached_vs_per_chunk
 
 # the driver's multi-chip entry must keep compiling + executing
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

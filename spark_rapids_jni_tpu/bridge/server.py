@@ -518,6 +518,22 @@ class BridgeServer:
         snap["histograms"] = metrics.histograms_snapshot()
         snap["gauges"] = metrics.gauges_snapshot()
         snap["queries"] = metrics.recent_summaries()
+        # per-device exchange attribution: the dev-suffixed gauges grouped
+        # into one block JNI-side pollers can chart without name parsing
+        dev_gauges = metrics.gauges_snapshot("engine.exchange.dev")
+        if dev_gauges:
+            snap["devices"] = {
+                "exchange_rows": {k.split(".")[2][3:]: v
+                                  for k, v in dev_gauges.items()
+                                  if k.endswith(".rows")},
+                "skew": metrics.gauges_snapshot("engine.exchange.skew")
+                .get("engine.exchange.skew"),
+                "straggler_share":
+                    metrics.gauges_snapshot("engine.exchange.straggler")
+                    .get("engine.exchange.straggler_share")}
+        from ..utils import profile
+        if profile.enabled():
+            snap["profile_store"] = profile.store_summary()
         if timeline.enabled():
             # Chrome trace-event JSON, ready for chrome://tracing/Perfetto
             snap["timeline"] = timeline.export()
@@ -562,8 +578,13 @@ class BridgeServer:
                 try:
                     m.close()
                     shmlib.unlink(name)
-                except (BufferError, OSError):
-                    pass  # a straggler worker still maps it; best-effort
+                except (BufferError, OSError) as e:
+                    # a straggler worker still maps it; best-effort — but
+                    # counted, so the skew telemetry can see stragglers
+                    # that outlive their exchange
+                    from ..utils import metrics as _metrics
+                    _metrics.count("bridge.straggler_remaps")
+                    self._log.debug("straggler remap of %s: %s", name, e)
 
     def _serve_client(self, conn: socket.socket) -> None:
         with self._conns_lock:

@@ -347,6 +347,11 @@ def _broadcast_exchange(node: Exchange, table: Table) -> Table:
     qm = metrics.current()
     if qm is not None:
         qm.node_add(id(node), node_label(node), wire_bytes=wire)
+        # a replicate is structurally balanced: every device receives the
+        # whole build side, so the skew columns render 1.0 by construction
+        qm.node_set(id(node), node_label(node), skew=1.0,
+                    straggler_share=0.0, max_dev_rows=table.num_rows,
+                    dev_rows=[table.num_rows] * ndev)
     if ndev <= 1:
         return table
     with timeline.span("engine.exchange.broadcast",
@@ -429,34 +434,92 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
             yield staged(slice_table(table, lo,
                                      min(rows - lo, _EXCHANGE_CHUNK_ROWS)))
 
+    tl = timeline.enabled()
+    fbase = timeline.new_flow_base() if tl else 0
+    outs = []
     with timeline.span("engine.exchange.hash", {"chunks": int(nchunks)}):
-        outs = list(sh.shuffle_chunks_pipelined(
-            chunk_stream(), mesh, keys, capacity=capacity,
-            depth=max(1, ctx.prefetch), key_specs=key_specs))
+        for ci, item in enumerate(sh.shuffle_chunks_pipelined(
+                chunk_stream(), mesh, keys, capacity=capacity,
+                depth=max(1, ctx.prefetch), key_specs=key_specs)):
+            if tl:
+                # flow arrow tails at dispatch — one flow per (chunk,
+                # dest device); heads land on the device lanes at receipt
+                for d in range(ndev):
+                    timeline.flow_start("engine.exchange.chunk",
+                                        fbase + ci * ndev + d,
+                                        {"chunk": ci})
+            outs.append(item)
 
     # one deliberate barrier: the ok masks reach the host and the padded
     # receive slots compact to live rows (distributed.py's compact idiom)
     metrics.host_sync(key=id(node), label="exchange-compaction")
+    # per-(src, dest) attribution rides the ok masks ALREADY fetched for
+    # compaction — zero additional syncs.  Receive layout of the global ok
+    # vector is [dest, src, slot] (all_to_all splits the send grid's dest
+    # axis across shards); transpose to conventional [src, dest] accounting
+    attrib = metrics.enabled() or tl
+    rows_mat = np.zeros((ndev, ndev), np.int64) if attrib else None
+    wire_mat = np.zeros((ndev, ndev), np.int64) if attrib else None
+    cap_rows = 0                        # receive slots per destination
+    dev_cum = np.zeros(ndev, np.int64)  # cumulative per-device rows (tl)
     wire = 0
     buf = [[] for _ in table.columns]
     bufv = [[] for _ in table.columns]
-    for out, ok, ovf in outs:
+    for ci, (out, ok, ovf) in enumerate(outs):
         if int(np.asarray(ovf)):
             raise RuntimeError(
                 "hash exchange overflow despite counts-sized capacity")
         wire += out.num_rows * layout.row_size  # every slot crosses the wire
         keep = np.asarray(ok)
+        t_c0 = time.perf_counter()
         for i, c in enumerate(out.columns):
             buf[i].append(np.asarray(c.data)[keep])
             bufv[i].append(np.ones(int(keep.sum()), bool)
                            if c.validity is None
                            else np.asarray(c.validity)[keep])
+        if attrib:
+            cap_c = out.num_rows // (ndev * ndev)
+            okm = keep.reshape(ndev, ndev, cap_c)
+            rows_mat += okm.sum(axis=2).T
+            wire_mat += cap_c * layout.row_size  # every slot, per pair
+            cap_rows += ndev * cap_c
+            if tl:
+                dur = time.perf_counter() - t_c0
+                chunk_dev = okm.sum(axis=(1, 2))
+                dev_cum += chunk_dev
+                for d in range(ndev):
+                    timeline.complete("engine.exchange.recv", t_c0, dur,
+                                      {"chunk": ci,
+                                       "rows": int(chunk_dev[d])}, dev=d)
+                    timeline.flow_finish("engine.exchange.chunk",
+                                         fbase + ci * ndev + d, dev=d)
+                    timeline.counter("engine.exchange.dev_rows",
+                                     int(dev_cum[d]), dev=d)
     metrics.count("engine.exchange.shuffles")
     metrics.count("engine.exchange.wire_bytes", wire)
     qm = metrics.current()
     if qm is not None:
         qm.node_add(id(node), node_label(node), chunks=nchunks,
                     wire_bytes=wire)
+    if metrics.enabled() and rows_mat is not None:
+        st = sh.device_load_stats(rows_mat.sum(axis=0))
+        metrics.gauge_set("engine.exchange.skew", st["skew"])
+        metrics.gauge_set("engine.exchange.straggler_share",
+                          st["straggler_share"])
+        metrics.gauge_set("engine.exchange.max_dev_rows",
+                          st["max_dev_rows"])
+        for d, r in enumerate(st["dev_rows"]):
+            metrics.gauge_set(f"engine.exchange.dev{d}.rows", float(r))
+            metrics.observe("engine.exchange.dev_rows", r)
+        if qm is not None:
+            qm.node_set(id(node), node_label(node),
+                        skew=st["skew"],
+                        straggler_share=st["straggler_share"],
+                        max_dev_rows=st["max_dev_rows"],
+                        cap_rows=cap_rows,
+                        dev_rows=st["dev_rows"],
+                        rows_matrix=rows_mat.tolist(),
+                        wire_matrix=wire_mat.tolist())
     cols = []
     for dt, ds, vs in zip(table.dtypes(), buf, bufv):
         v = np.concatenate(vs)
@@ -834,6 +897,15 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
     # one QueryMetrics per top-level execute (nested/re-entrant executes
     # attribute into the enclosing query); SRJT_METRICS=0 skips entirely
     with metrics.maybe_query(f"execute:{node_label(plan)}") as qm:
+        if config.profile_dir:
+            # the profile store keys cross-run diffs by plan fingerprint;
+            # stamp whichever query context covers this execute — the one
+            # just opened, or a caller's (the bridge wraps PLAN_EXECUTE in
+            # its own query). First plan wins under a multi-execute query.
+            # Only pay the canonical-serialize cost when the store is on.
+            cq = qm if qm is not None else metrics.current()
+            if cq is not None and not cq.fingerprint:
+                cq.fingerprint = plan.fingerprint()
         out = _exec(plan, {}, stats, ctx)
         if qm is not None:
             qm.note_stats(stats)

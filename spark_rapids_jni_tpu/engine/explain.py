@@ -144,6 +144,16 @@ def _annotate(span: Optional[dict], ceiling: Optional[float] = None) -> str:
             bits.append(f"exch_GB/s={gbps:.3f}")
             if ceiling:
                 bits.append(f"exch_roofline_frac={gbps / ceiling:.6f}")
+    if span.get("skew") is not None:
+        # per-device exchange attribution (executor._hash_exchange /
+        # _broadcast_exchange): destination-load balance + breakdown
+        bits.append(f"skew={span['skew']:.2f}")
+        if span.get("straggler_share") is not None:
+            bits.append(f"straggler={span['straggler_share']:.2f}")
+        if span.get("max_dev_rows") is not None:
+            bits.append(f"max_dev_rows={span['max_dev_rows']}")
+        if span.get("dev_rows"):
+            bits.append(f"dev_rows={list(span['dev_rows'])}")
     return "[" + " ".join(bits) + "]"
 
 
@@ -204,6 +214,10 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
     qm = None
     with metrics.query(f"explain:{node_label(opt)}") as q:
         qm = q
+        if q is not None:
+            from ..utils.config import config
+            if config.profile_dir:
+                q.fingerprint = opt.fingerprint()
         out = execute(opt, stats, fused=fused, prefetch=prefetch)
         if q is not None:
             q.note_stats(stats)

@@ -25,6 +25,8 @@ environment flags read once at import:
 | ``SRJT_VERIFY``       | ``1``   | static plan verification in optimize()/PLAN_EXECUTE (engine/verify.py) |
 | ``SRJT_DIST``         | ``0``   | partitioning-aware distributed planning (Exchange placement rules) |
 | ``SRJT_BROADCAST_ROWS`` | ``100000`` | broadcast-join threshold: estimated build rows at or under this replicate instead of shuffling |
+| ``SRJT_PROFILE_DIR``  | *(unset)* | persist one compact query profile JSON per query into this dir (utils/profile.py; empty = off) |
+| ``SRJT_PROFILE_CAP``  | ``512`` | on-disk profile ring capacity (oldest profiles pruned past this) |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -76,6 +78,8 @@ class Config:
     verify: bool = True          # static plan verification (engine/verify.py)
     distribute: bool = False     # Exchange-placement distributed planning
     broadcast_rows: int = 100_000  # broadcast-join build-size threshold (rows)
+    profile_dir: str = ""        # query-profile store dir (empty = off)
+    profile_cap: int = 512       # profile-store ring capacity (files)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -99,6 +103,8 @@ class Config:
             verify=_bool_flag("SRJT_VERIFY", True),
             distribute=_bool_flag("SRJT_DIST", False),
             broadcast_rows=_int_flag("SRJT_BROADCAST_ROWS", 100_000),
+            profile_dir=os.environ.get("SRJT_PROFILE_DIR", "").strip(),
+            profile_cap=_int_flag("SRJT_PROFILE_CAP", 512, minimum=1),
         )
 
 

@@ -89,14 +89,47 @@ def _hist_add(hists: dict, name: str, value: float) -> None:
     h["buckets"][le] = h["buckets"].get(le, 0) + 1
 
 
+def _hist_percentiles(h: dict, qs=(0.5, 0.9, 0.99)) -> dict:
+    """Derived p50/p90/p99 from the power-of-two buckets.
+
+    A value in bucket ``le`` lies in ``(le/2, le]``, so a percentile
+    interpolated linearly inside its bucket carries at most a 2x
+    (one-bucket-width) error — tight enough to rank latency tails and
+    device-load distributions without pre-declared bucket edges.  Results
+    clamp to the observed [min, max], so a single-valued histogram reports
+    that exact value at every percentile.
+    """
+    n = h["count"]
+    if not n:
+        return {f"p{int(q * 100)}": None for q in qs}
+    items = sorted(h["buckets"].items())
+    out = {}
+    for q in qs:
+        target = q * n
+        cum = 0.0
+        val = h["max"]
+        for le, c in items:
+            if cum + c >= target:
+                if le <= 0:
+                    val = 0.0
+                else:
+                    lo = le / 2.0
+                    val = lo + (le - lo) * ((target - cum) / c)
+                break
+            cum += c
+        out[f"p{int(q * 100)}"] = min(max(val, h["min"]), h["max"])
+    return out
+
+
 def _hist_dump(h: dict) -> dict:
     """JSON-friendly histogram copy: buckets as sorted [le, count] pairs
-    plus ``sum``/``count`` (and the derived ``mean``) so consumers of the
-    OP_METRICS reply compute averages without re-deriving from
-    power-of-two bucket midpoints."""
+    plus ``sum``/``count`` (and the derived ``mean`` and p50/p90/p99) so
+    consumers of the OP_METRICS reply compute averages and tails without
+    re-deriving from power-of-two bucket midpoints."""
     return {"count": h["count"], "sum": h["sum"],
             "mean": (h["sum"] / h["count"]) if h["count"] else None,
             "min": h["min"], "max": h["max"],
+            **_hist_percentiles(h),
             "buckets": sorted([le, n] for le, n in h["buckets"].items())}
 
 
@@ -124,7 +157,8 @@ class QueryMetrics:
     """
 
     __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
-                 "node_spans", "hists", "timers", "mem", "_lock")
+                 "node_spans", "hists", "timers", "mem", "fingerprint",
+                 "_lock")
 
     def __init__(self, name: str = ""):
         self.qid = next(_qids)
@@ -137,6 +171,7 @@ class QueryMetrics:
         self.hists: dict[str, dict] = {}
         self.timers: dict[str, float] = {}
         self.mem: dict = {}  # device-memory telemetry (mem_sample)
+        self.fingerprint: str = ""  # plan fingerprint (profile-store key)
         self._lock = threading.Lock()
 
     def count(self, name: str, n: int = 1) -> None:
@@ -151,16 +186,34 @@ class QueryMetrics:
         with self._lock:
             self.timers[name] = self.timers.get(name, 0.0) + dt
 
+    def _span_record(self, key, label: str) -> dict:
+        r = self.node_spans.get(key)
+        if r is None:
+            r = self.node_spans[key] = dict.fromkeys(_NODE_FIELDS, 0)
+            r["wall_s"] = 0.0
+            r["label"] = label
+        return r
+
     def node_add(self, key, label: str, **fields) -> None:
         """Accumulate span fields (``_NODE_FIELDS``) onto node ``key``."""
         with self._lock:
-            r = self.node_spans.get(key)
-            if r is None:
-                r = self.node_spans[key] = dict.fromkeys(_NODE_FIELDS, 0)
-                r["wall_s"] = 0.0
-                r["label"] = label
+            r = self._span_record(key, label)
             for k, v in fields.items():
                 r[k] += v
+
+    def node_set(self, key, label: str, **fields) -> None:
+        """SET derived span fields on node ``key`` (no accumulation).
+
+        For values that are not running sums — an Exchange's skew ratio,
+        straggler share, or per-device row breakdown, computed once from
+        the whole exchange — where ``node_add``'s ``+=`` would corrupt.
+        Also re-stamps ``label``: the caller passing derived fields knows
+        the node's real name, which beats whatever incidental recorder
+        (a keyed host_sync) created the record first."""
+        with self._lock:
+            r = self._span_record(key, label)
+            r["label"] = label
+            r.update(fields)
 
     @contextlib.contextmanager
     def node_span(self, key, label: str):
@@ -214,6 +267,8 @@ class QueryMetrics:
                    "histograms": {k: _hist_dump(h)
                                   for k, h in self.hists.items()},
                    "nodes": nodes}
+            if self.fingerprint:
+                out["fingerprint"] = self.fingerprint
             if self.mem:
                 out["memory"] = dict(self.mem)
             return out
@@ -241,8 +296,18 @@ def query(name: str = ""):
     finally:
         _tls.q = prev
         qm.finish()
+        summary = qm.summary()
         with _lock:
-            _recent.append(qm.summary())
+            _recent.append(summary)
+        if config.profile_dir:
+            # persist one compact profile per query (utils/profile.py);
+            # profile IO must never fail the query it describes
+            try:
+                from . import profile
+                profile.write(summary)
+            except Exception as e:  # noqa: BLE001 — best-effort telemetry
+                from .config import logger
+                logger().debug("profile write failed: %s", e)
 
 
 @contextlib.contextmanager

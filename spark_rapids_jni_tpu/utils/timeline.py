@@ -52,8 +52,19 @@ _buf: deque | None = None      # created lazily at first record / reset()
 _buf_cap = 0
 _thread_names: dict[int, str] = {}
 _flow_seq = itertools.count(1)
+_dropped = 0                   # events evicted by ring overflow
+_warned: set[str] = set()      # query names already warned about overflow
 
 _PID = os.getpid()
+
+#: synthetic tid base for per-device lanes — far above any OS thread id,
+#: so device lanes render as their own named rows next to real threads
+_DEV_TID_BASE = 1 << 48
+
+
+def device_lane(dev: int) -> int:
+    """Synthetic tid of device ``dev``'s timeline lane."""
+    return _DEV_TID_BASE + int(dev)
 
 
 def enabled() -> bool:
@@ -88,17 +99,42 @@ def _buffer() -> deque:
     return _buf
 
 
-def _append(ev: dict) -> None:
-    tid = threading.get_ident()
+def _append(ev: dict, dev: int | None = None) -> None:
+    global _dropped
+    if dev is None:
+        tid, tname = threading.get_ident(), None
+    else:
+        tid, tname = device_lane(dev), f"device:{int(dev)}"
     ev["pid"] = _PID
     ev["tid"] = tid
     q = _qname()
     if q is not None:
         ev.setdefault("args", {})["query"] = q
+    dropped_now = warn = False
     with _lock:
         if tid not in _thread_names:
-            _thread_names[tid] = threading.current_thread().name
-        _buffer().append(ev)
+            _thread_names[tid] = tname if tname is not None \
+                else threading.current_thread().name
+        buf = _buffer()
+        if buf.maxlen is not None and len(buf) == buf.maxlen:
+            _dropped += 1
+            dropped_now = True
+            qkey = q or ""
+            if qkey not in _warned:
+                _warned.add(qkey)
+                warn = True
+        buf.append(ev)
+    if dropped_now:
+        # overflow gauge lives in the metrics layer; lazy import breaks
+        # the metrics -> timeline load-time edge
+        from . import metrics
+        metrics.gauge_set("timeline.dropped_events", float(_dropped))
+    if warn:
+        from .config import logger
+        logger().warning(
+            "timeline ring overflow%s: oldest events dropped "
+            "(raise SRJT_TIMELINE_CAP, currently %d)",
+            f" in query {q!r}" if q else "", config.timeline_cap)
 
 
 # -- recording ---------------------------------------------------------------
@@ -122,33 +158,37 @@ def span(name: str, args: dict | None = None):
 
 
 def complete(name: str, t0_s: float, dur_s: float,
-             args: dict | None = None) -> None:
+             args: dict | None = None, dev: int | None = None) -> None:
     """Record an already-measured span (perf_counter seconds), for call
-    sites that timed the region themselves (segment compile/replay)."""
+    sites that timed the region themselves (segment compile/replay).
+    ``dev`` routes the slice onto that device's lane instead of the
+    calling thread's row (per-device exchange receipt)."""
     if not config.timeline:
         return
     ev = {"name": name, "ph": "X", "ts": t0_s * 1e6, "dur": dur_s * 1e6}
     if args:
         ev["args"] = dict(args)
-    _append(ev)
+    _append(ev, dev=dev)
 
 
-def instant(name: str, args: dict | None = None) -> None:
+def instant(name: str, args: dict | None = None,
+            dev: int | None = None) -> None:
     """Thread-scoped instant ("i") event — the host-sync markers."""
     if not config.timeline:
         return
     ev = {"name": name, "ph": "i", "ts": _now_us(), "s": "t"}
     if args:
         ev["args"] = dict(args)
-    _append(ev)
+    _append(ev, dev=dev)
 
 
-def counter(name: str, value: float) -> None:
-    """Counter-track ("C") sample, e.g. device live-bytes over time."""
+def counter(name: str, value: float, dev: int | None = None) -> None:
+    """Counter-track ("C") sample, e.g. device live-bytes over time; with
+    ``dev``, a per-device track (cumulative exchange rows per device)."""
     if not config.timeline:
         return
     _append({"name": name, "ph": "C", "ts": _now_us(),
-             "args": {"value": float(value)}})
+             "args": {"value": float(value)}}, dev=dev)
 
 
 def new_flow_base() -> int:
@@ -168,16 +208,19 @@ def flow_start(name: str, flow_id: int, args: dict | None = None) -> None:
     _append(ev)
 
 
-def flow_finish(name: str, flow_id: int, args: dict | None = None) -> None:
+def flow_finish(name: str, flow_id: int, args: dict | None = None,
+                dev: int | None = None) -> None:
     """Flow arrow head ("f", binding to the enclosing slice): the consumer
-    side of the handoff recorded by ``flow_start`` with the same id."""
+    side of the handoff recorded by ``flow_start`` with the same id.
+    ``dev`` lands the arrow head on that device's lane (exchange dispatch
+    -> per-device receipt)."""
     if not config.timeline:
         return
     ev = {"name": name, "ph": "f", "ts": _now_us(), "id": int(flow_id),
           "cat": "flow", "bp": "e"}
     if args:
         ev["args"] = dict(args)
-    _append(ev)
+    _append(ev, dev=dev)
 
 
 # -- export / lifecycle ------------------------------------------------------
@@ -195,12 +238,14 @@ def export() -> dict:
     with _lock:
         events = [dict(e) for e in (_buf or ())]
         names = dict(_thread_names)
+        dropped = _dropped
     meta = [{"name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
              "args": {"name": "spark_rapids_jni_tpu"}}]
     for tid, tname in sorted(names.items()):
         meta.append({"name": "thread_name", "ph": "M", "pid": _PID,
                      "tid": tid, "args": {"name": tname}})
-    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": dropped}}
 
 
 def dump(path: str) -> str:
@@ -212,10 +257,18 @@ def dump(path: str) -> str:
     return path
 
 
+def dropped_events() -> int:
+    """Events evicted by ring overflow since the last ``reset()``."""
+    with _lock:
+        return _dropped
+
+
 def reset() -> None:
     """Drop all buffered events (tests; also picks up a changed cap)."""
-    global _buf, _buf_cap
+    global _buf, _buf_cap, _dropped
     with _lock:
         _buf = None
         _buf_cap = 0
+        _dropped = 0
         _thread_names.clear()
+        _warned.clear()

@@ -403,6 +403,142 @@ def test_string_key_exchange_places_spark_exact(tmp_path):
     pd.testing.assert_frame_equal(got, oracle, check_dtype=False)
 
 
+# -- per-device exchange attribution (docs/OBSERVABILITY.md) ----------------
+
+def test_device_load_stats_balanced_skewed_empty():
+    """The shared skew/straggler helper both the shuffle counts pass and
+    the executor report through: 1.0 balanced, ndev on one-destination,
+    and a zero-row exchange is balanced by definition (no 0/0)."""
+    from spark_rapids_jni_tpu.parallel.shuffle import device_load_stats
+    st = device_load_stats(np.full(8, 25, np.int64))
+    assert st["skew"] == 1.0 and st["straggler_share"] == 0.0
+    assert st["max_dev_rows"] == 25 and st["total_rows"] == 200
+    hot = np.zeros(8, np.int64)
+    hot[3] = 160
+    st = device_load_stats(hot)
+    assert st["skew"] == 8.0
+    assert st["straggler_share"] == pytest.approx(7 / 8)
+    assert st["dev_rows"][3] == st["max_dev_rows"] == 160
+    st = device_load_stats(np.zeros(8, np.int64))
+    assert st["skew"] == 1.0 and st["straggler_share"] == 0.0
+
+
+def test_exchange_wire_matrix_sums_to_counter(warehouse, monkeypatch):
+    """The acceptance invariant ci/premerge.sh asserts on the smoke
+    artifact: summing every exchange's per-(src, dest) wire matrix
+    reproduces the query's engine.exchange.wire_bytes counter exactly,
+    and the derived per-device columns are internally consistent."""
+    from spark_rapids_jni_tpu.utils import metrics
+    if not metrics.enabled():
+        pytest.skip("SRJT_METRICS off")
+    root, _, _ = warehouse
+    monkeypatch.setenv("SRJT_BROADCAST_ROWS", "0")
+    cfg.refresh()
+    try:
+        with metrics.query("dist-attrib") as qm:
+            execute(optimize(_join_agg(root), distribute=True), new_stats())
+    finally:
+        monkeypatch.delenv("SRJT_BROADCAST_ROWS")
+        cfg.refresh()
+    summ = qm.summary()
+    ex = [n for n in summ["nodes"] if n.get("wire_matrix")]
+    assert len(ex) == 3      # both join sides + the partial-agg exchange
+    total = sum(sum(map(sum, n["wire_matrix"])) for n in ex)
+    assert total == summ["counters"]["engine.exchange.wire_bytes"]
+    for n in ex:
+        rows = np.asarray(n["rows_matrix"])
+        assert rows.shape == (8, 8)
+        # dev_rows IS the matrix's per-destination column sum
+        np.testing.assert_array_equal(rows.sum(axis=0), n["dev_rows"])
+        assert n["max_dev_rows"] == max(n["dev_rows"])
+        assert n["skew"] >= 1.0
+        assert 0.0 <= n["straggler_share"] < 1.0
+
+
+def test_exchange_skew_balanced_vs_skewed(tmp_path, metrics_isolation):
+    """skew == 1.0 when every destination receives the same row count;
+    == ndev (and straggler_share (ndev-1)/ndev) when a seeded hot key
+    routes every row to one device.  Gauges mirror the span fields."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.parallel.shuffle import partition_ids
+    from spark_rapids_jni_tpu.utils import metrics
+    if not metrics.enabled():
+        pytest.skip("SRJT_METRICS off")
+    metrics_isolation("engine.exchange")
+
+    pool = np.arange(4096, dtype=np.int64)
+    dests = np.asarray(partition_ids(
+        Table([Column.from_numpy(pool)], ["k"]), 8))
+    # one representative key per destination device
+    reps = np.array([pool[dests == d][0] for d in range(8)])
+
+    def run(keys, name):
+        v = np.arange(len(keys), dtype=np.int64)
+        p = tmp_path / f"{name}.parquet"
+        pq.write_table(pa.table({"k": pa.array(keys), "v": pa.array(v)}), p)
+        plan = Aggregate(Exchange(Scan(p), ("k",), "hash"),
+                         ("k",), (("v", "sum"),), ("t",))
+        with metrics.query(name) as qm:
+            execute(optimize(plan), new_stats())
+        spans = [n for n in qm.summary()["nodes"] if n.get("rows_matrix")]
+        assert len(spans) == 1
+        return spans[0]
+
+    bal = run(np.tile(reps, 200), "balanced")     # 200 rows per device
+    assert bal["skew"] == 1.0
+    assert bal["straggler_share"] == 0.0
+    assert bal["dev_rows"] == [200] * 8
+
+    hot = run(np.repeat(reps[2], 1600), "skewed")  # one destination
+    assert hot["skew"] == 8.0
+    assert hot["straggler_share"] == pytest.approx(7 / 8)
+    assert hot["max_dev_rows"] == 1600
+    assert hot["dev_rows"][int(dests[reps[2]])] == 1600
+    from spark_rapids_jni_tpu.utils import metrics as m
+    g = m.gauges_snapshot("engine.exchange")
+    assert g["engine.exchange.skew"] == 8.0
+    assert g["engine.exchange.max_dev_rows"] == 1600.0
+
+
+def test_broadcast_exchange_attributed_balanced(warehouse):
+    """A broadcast replicates the build to every device — structurally
+    balanced, so its span reports skew 1.0 / dev_rows == num_rows on all
+    lanes without any matrix (nothing is partitioned)."""
+    from spark_rapids_jni_tpu.utils import metrics
+    if not metrics.enabled():
+        pytest.skip("SRJT_METRICS off")
+    root, _, _ = warehouse
+    with metrics.query("bcast-attrib") as qm:
+        execute(optimize(_join_agg(root), distribute=True), new_stats())
+    spans = [n for n in qm.summary()["nodes"]
+             if n.get("skew") is not None and not n.get("rows_matrix")]
+    assert spans, "broadcast exchange did not report device balance"
+    b = spans[0]
+    assert b["skew"] == 1.0 and b["straggler_share"] == 0.0
+    assert b["max_dev_rows"] == N_DIM
+    assert b["dev_rows"] == [N_DIM] * 8
+
+
+def test_explain_analyze_renders_device_columns(warehouse):
+    """EXPLAIN ANALYZE on the dist plan carries the per-device columns:
+    skew, straggler share, max_dev_rows, and the dev_rows breakdown."""
+    from spark_rapids_jni_tpu.engine.explain import explain_analyze
+    root, _, _ = warehouse
+    os.environ["SRJT_DIST"] = "1"
+    cfg.refresh()
+    try:
+        rep = explain_analyze(_join_agg(root))
+    finally:
+        del os.environ["SRJT_DIST"]
+        cfg.refresh()
+    if not rep.summary:
+        pytest.skip("SRJT_METRICS off")
+    assert "skew=" in rep.text
+    assert "straggler=" in rep.text
+    assert "max_dev_rows=" in rep.text
+    assert "dev_rows=[" in rep.text
+
+
 def test_explain_analyze_renders_exchanges(warehouse):
     from spark_rapids_jni_tpu.engine.explain import explain_analyze
     root, _, _ = warehouse

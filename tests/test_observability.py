@@ -567,3 +567,84 @@ def test_bench_gate_repo_artifacts_parse():
     for key, spec in specs.items():
         assert spec["direction"] in ("higher", "lower")
         assert float(spec["reference"]) > 0
+
+
+def test_bench_gate_enforce_keys_allowlist(tmp_path, capsys):
+    """--enforce-keys narrows the flip: only allowlisted regressions (or
+    allowlisted keys the artifact silently dropped) fail the gate; every
+    other key keeps reporting without gating."""
+    bg = _load_bench_gate()
+    baselines = tmp_path / "pins.json"
+    baselines.write_text(json.dumps({"_gate": {
+        "tolerance_default": 0.2,
+        "metrics": {
+            "soaked.value": {"reference": 100.0, "direction": "higher"},
+            "fresh.value": {"reference": 100.0, "direction": "higher"},
+        }}}))
+    art = tmp_path / "bench.json"
+    # fresh regresses hard, soaked is within tolerance
+    art.write_text("\n".join([
+        json.dumps({"metric": "soaked", "value": 99.0}),
+        json.dumps({"metric": "fresh", "value": 10.0})]))
+    common = ["--artifact", str(art), "--baselines", str(baselines),
+              "--enforce"]
+    assert bg.main(common + ["--enforce-keys", "soaked.value"]) == 0
+    assert bg.main(common + ["--enforce-keys", "fresh.value"]) == 1
+    assert bg.main(common) == 1        # no allowlist: every key enforces
+    # a DROPPED allowlisted key fails too (missing == regression)
+    art.write_text(json.dumps({"metric": "fresh", "value": 200.0}))
+    assert bg.main(common + ["--enforce-keys", "soaked.value"]) == 1
+    out = capsys.readouterr().out
+    assert '"enforced_failures": ["soaked.value"]' in out
+
+
+def test_bench_gate_profiles_fold(tmp_path):
+    """--profiles DIR folds the query-profile store into gateable keys:
+    worst-case max across profiles, torn files and strangers skipped."""
+    bg = _load_bench_gate()
+    pdir = tmp_path / "store"
+    pdir.mkdir()
+    (pdir / "profile-001-aaa.json").write_text(json.dumps({
+        "exchanges": [{"skew": 1.2, "straggler_share": 0.1}],
+        "histograms": {"engine.stream.chunk_latency_s": {"p99": 0.01}}}))
+    (pdir / "profile-002-bbb.json").write_text(json.dumps({
+        "exchanges": [{"skew": 3.5, "straggler_share": 0.7}],
+        "histograms": {"engine.stream.chunk_latency_s": {"p99": 0.002}}}))
+    (pdir / "profile-003-ccc.json").write_text("{torn")   # skipped
+    (pdir / "notes.txt").write_text("not a profile")      # ignored
+    assert bg.profile_keys(str(pdir)) == {
+        "profile.exchange.skew": 3.5,
+        "profile.exchange.straggler_share": 0.7,
+        "profile.chunk_latency.p99": 0.01}
+    assert bg.profile_keys(str(tmp_path / "missing")) == {}
+    baselines = tmp_path / "pins.json"
+    baselines.write_text(json.dumps({"_gate": {"metrics": {
+        "profile.exchange.skew": {"reference": 1.3, "direction": "lower",
+                                  "tolerance": 1.0}}}}))
+    s = bg.run_gate("", str(baselines), profiles_dir=str(pdir))
+    # 3.5 > 1.3 * (1 + 1.0): the skewed run trips the lower-is-better key
+    assert s["rows"]["profile.exchange.skew"]["status"] == "regression"
+
+
+def test_histogram_percentiles_in_snapshot(metrics_isolation):
+    """Power-of-two-bucket percentiles: ordered, clamped to [min, max],
+    within the documented 2x error bound, and a single observation
+    collapses every percentile to its (clamped) value."""
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics_isolation("test.pct")
+    for v in range(1, 101):
+        metrics.observe("test.pct.lat", float(v))
+    h = metrics.histograms_snapshot("test.pct")["test.pct.lat"]
+    assert h["min"] <= h["p50"] <= h["p90"] <= h["p99"] <= h["max"]
+    for q, exact in (("p50", 50.0), ("p90", 90.0), ("p99", 99.0)):
+        assert exact / 2 <= h[q] <= exact * 2, q
+    metrics.observe("test.pct.one", 3.0)
+    h1 = metrics.histograms_snapshot("test.pct")["test.pct.one"]
+    assert h1["p50"] == h1["p90"] == h1["p99"] == 3.0
+    # the same fields ride the per-query summary (the profile-store path)
+    with metrics.query("pctq") as qm:
+        if qm is None:
+            return                     # SRJT_METRICS off: nothing to pin
+        metrics.observe("test.pct.q", 7.0)
+    hq = metrics.recent_summaries()[-1]["histograms"]["test.pct.q"]
+    assert hq["p50"] == hq["p99"] == 7.0

@@ -1,0 +1,154 @@
+"""SRJT_PROFILE_DIR: the persistent query-profile store (utils/profile.py)
+and its CLI (tools/srjt_profile.py).
+
+Pins the store's four contracts:
+
+- round-trip losslessness for every gated key (exchange skew / straggler
+  share / wire_bytes, histogram percentiles, kept counters) — the bench
+  gate and the diff tool read profiles, never live registries;
+- ``metrics.query()`` auto-persists one profile per query when the flag
+  is set, into a ring bounded by ``SRJT_PROFILE_CAP`` (oldest pruned);
+- ``diff`` attributes regressions: node slowed, cache stopped hitting,
+  exchange skewed, latency tail grew;
+- the CLI renders list/show/diff over the same store and auto-pairs the
+  newest two runs sharing a plan fingerprint.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from spark_rapids_jni_tpu.utils import config as cfg
+from spark_rapids_jni_tpu.utils import metrics, profile
+
+FP = "deadbeefcafe" + "0" * 52
+
+
+def _make_summary(name="q", wall_scale=1.0, skew=1.25, hits=8):
+    """One synthetic query summary shaped like a real engine run."""
+    with metrics.query(name) as qm:
+        if qm is None:
+            pytest.skip("SRJT_METRICS off")
+        qm.fingerprint = FP
+        qm.node_add(1, "Scan[fact]", wall_s=0.004 * wall_scale,
+                    rows_out=4_000, chunks=4, bytes_out=64_000)
+        qm.node_add(2, "Exchange(hash)", wall_s=0.006 * wall_scale,
+                    rows_in=4_000, rows_out=4_000, wire_bytes=131_072)
+        qm.node_set(2, "Exchange(hash)", skew=skew,
+                    straggler_share=round(1 - 1 / skew, 6),
+                    max_dev_rows=int(500 * skew), dev_rows=[500] * 8)
+        qm.count("engine.exchange.wire_bytes", 131_072)
+        if hits:
+            qm.count("engine.build_cache.hit", hits)
+        qm.count("engine.host_sync", 3)
+        for v in (0.001, 0.002, 0.004, 0.032 * wall_scale):
+            qm.observe("engine.stream.chunk_latency_s", v)
+    return metrics.recent_summaries()[-1]
+
+
+def test_profile_round_trip_lossless(tmp_path):
+    """write -> read preserves every gated key bit-for-bit."""
+    summ = _make_summary("rt")
+    path = profile.write(summ, dir_path=str(tmp_path))
+    prof = profile.read(path)
+    assert prof["version"] == profile.VERSION
+    assert prof["fingerprint"] == FP
+    (e,) = [x for x in prof["exchanges"] if x["label"] == "Exchange(hash)"]
+    assert e["skew"] == 1.25 and e["wire_bytes"] == 131_072
+    assert e["straggler_share"] == round(1 - 1 / 1.25, 6)
+    assert e["max_dev_rows"] == 625 and e["dev_rows"] == [500] * 8
+    live = summ["histograms"]["engine.stream.chunk_latency_s"]
+    h = prof["histograms"]["engine.stream.chunk_latency_s"]
+    for f in ("count", "sum", "mean", "min", "max", "p50", "p90", "p99"):
+        assert h[f] == live[f], f
+    assert h["p50"] <= h["p90"] <= h["p99"] <= h["max"]
+    assert prof["counters"]["engine.exchange.wire_bytes"] == 131_072
+    assert prof["counters"]["engine.build_cache.hit"] == 8
+    assert prof["counters"]["engine.host_sync"] == 3
+    # filename: zero-padded ns timestamp then fp12, so lexical order IS
+    # chronological and same-plan runs grep together
+    base = os.path.basename(path)
+    assert base.startswith("profile-")
+    assert base.endswith(f"-{FP[:12]}.json")
+
+
+def test_query_auto_writes_bounded_ring(tmp_path, monkeypatch):
+    """metrics.query() persists one profile per query when the flag is
+    set; the ring keeps only the SRJT_PROFILE_CAP newest."""
+    monkeypatch.setenv("SRJT_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("SRJT_PROFILE_CAP", "4")
+    cfg.refresh()
+    try:
+        assert profile.enabled()
+        for i in range(7):
+            _make_summary(f"q{i}")
+        paths = profile.list_profiles()
+        assert len(paths) == 4
+        assert [profile.read(p)["name"] for p in paths] == \
+            ["q3", "q4", "q5", "q6"]           # oldest pruned
+    finally:
+        monkeypatch.delenv("SRJT_PROFILE_DIR")
+        monkeypatch.delenv("SRJT_PROFILE_CAP")
+        cfg.refresh()
+    assert not profile.enabled()
+
+
+def test_store_summary_and_latest(tmp_path):
+    profile.write(_make_summary("a", skew=1.1), dir_path=str(tmp_path))
+    profile.write(_make_summary("b", skew=2.5), dir_path=str(tmp_path))
+    s = profile.store_summary(str(tmp_path))
+    assert s["profiles"] == 2
+    assert s["top_exchange_skew"] == 2.5       # worst across the store
+    assert s["chunk_latency_p99_s"] is not None
+    assert profile.latest(FP, dir_path=str(tmp_path))["name"] == "b"
+    assert profile.latest("0" * 64, dir_path=str(tmp_path)) is None
+
+
+def test_diff_flags_regression_attribution(tmp_path):
+    """cand ran 3x slower with a skewed exchange, a cold cache, and a
+    fatter latency tail — the diff names all four causes."""
+    base = profile.write(_make_summary("base"), dir_path=str(tmp_path))
+    cand = profile.write(_make_summary("cand", wall_scale=3.0, skew=2.0,
+                                       hits=0), dir_path=str(tmp_path))
+    d = profile.diff(base, cand)
+    assert d["fingerprint_match"]
+    kinds = {f.split(":")[0] for f in d["flags"]}
+    assert {"node-slowed", "cache-hits-dropped", "exchange-skew-up",
+            "p99-up"} <= kinds
+    text = profile.render_diff(d)
+    assert "flags:" in text and "Exchange(hash)" in text
+    # an identical pair attributes nothing
+    clean = profile.diff(base, base)
+    assert clean["flags"] == []
+    assert "flags: none" in profile.render_diff(clean)
+
+
+def _load_cli():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "srjt_profile.py")
+    spec = importlib.util.spec_from_file_location("srjt_profile_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_list_show_diff(tmp_path, capsys):
+    cli = _load_cli()
+    assert cli.main(["--dir", str(tmp_path), "diff"]) == 2   # empty store
+    capsys.readouterr()
+    profile.write(_make_summary("r1"), dir_path=str(tmp_path))
+    profile.write(_make_summary("r2", wall_scale=2.0),
+                  dir_path=str(tmp_path))
+    assert cli.main(["--dir", str(tmp_path), "list"]) == 0
+    out = capsys.readouterr().out
+    assert "2 profiles" in out and "top_exchange_skew" in out
+    assert cli.main(["--dir", str(tmp_path), "show", "-1"]) == 0
+    assert json.loads(capsys.readouterr().out)["name"] == "r2"
+    # no positionals: auto-pairs the newest two runs sharing a fingerprint
+    assert cli.main(["--dir", str(tmp_path), "diff"]) == 0
+    out = capsys.readouterr().out
+    assert "profile diff:" in out and "r1 -> r2" in out
+    assert cli.main(["--dir", str(tmp_path), "diff", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["fingerprint_match"] is True

@@ -235,3 +235,63 @@ def test_timeline_off_leaves_streaming_paths_clean(tmp_path, monkeypatch):
     finally:
         monkeypatch.delenv("SRJT_METRICS")
         cfg.refresh()
+
+
+def test_dropped_events_accounting(timeline_on, monkeypatch):
+    """Ring overflow is COUNTED, not silent: dropped_events(), the
+    timeline.dropped_events metrics gauge, and the export() metadata all
+    agree, and reset() clears the tally."""
+    monkeypatch.setenv("SRJT_TIMELINE_CAP", "16")
+    cfg.refresh()
+    timeline.reset()
+    assert timeline.dropped_events() == 0
+    for i in range(16):
+        timeline.instant(f"fill.{i}")
+    assert timeline.dropped_events() == 0   # full, but nothing evicted yet
+    for i in range(5):
+        timeline.instant(f"spill.{i}")
+    assert timeline.dropped_events() == 5
+    assert timeline.export()["otherData"]["dropped_events"] == 5
+    if metrics.enabled():
+        g = metrics.gauges_snapshot("timeline")
+        assert g["timeline.dropped_events"] == 5.0
+    timeline.reset()
+    assert timeline.dropped_events() == 0
+    assert timeline.export()["otherData"]["dropped_events"] == 0
+
+
+def test_overflow_warns_once_per_query(timeline_on, monkeypatch, caplog):
+    """The overflow warning fires once per query, not once per evicted
+    event — 24 drops, one log record."""
+    monkeypatch.setenv("SRJT_TIMELINE_CAP", "16")
+    cfg.refresh()
+    timeline.reset()
+    with caplog.at_level("WARNING", logger="spark_rapids_jni_tpu"):
+        with metrics.query("ovf"):
+            for i in range(40):
+                timeline.instant(f"t.{i}")
+    msgs = [r for r in caplog.records if "overflow" in r.getMessage()]
+    assert len(msgs) == 1
+    assert timeline.dropped_events() == 24
+
+
+def test_device_lanes_and_thread_names(timeline_on):
+    """dev= routes events onto synthetic per-device lanes (tids far above
+    any OS thread id) named device:N in the export metadata — the
+    per-device exchange-receipt rows next to real thread rows."""
+    timeline.complete("engine.exchange.recv", 0.0, 0.001, {"rows": 5},
+                      dev=3)
+    timeline.counter("engine.exchange.dev_rows", 5.0, dev=3)
+    timeline.instant("host.mark")
+    lane = timeline.device_lane(3)
+    assert lane >= (1 << 48)                 # clear of real OS tids
+    evs = timeline.events_snapshot()
+    dev_evs = [e for e in evs if e["tid"] == lane]
+    assert {e["ph"] for e in dev_evs} == {"X", "C"}
+    host = [e for e in evs if e["name"] == "host.mark"]
+    assert host and all(e["tid"] != lane for e in host)
+    meta = {e["tid"]: e["args"]["name"]
+            for e in timeline.export()["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert meta[lane] == "device:3"
+    _check_trace_schema(timeline.export())

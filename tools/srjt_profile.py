@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Query-profile store CLI: list / show / diff persisted query profiles.
+
+The reading half of ``utils/profile.py`` (docs/OBSERVABILITY.md): the
+engine writes one compact JSON profile per query into ``SRJT_PROFILE_DIR``;
+this tool renders the store without touching devices — pure JSON over the
+on-disk ring, safe to run anywhere the directory is mounted.
+
+Usage::
+
+    python tools/srjt_profile.py list  [--dir DIR]
+    python tools/srjt_profile.py show  [--dir DIR] [PATH|-1]
+    python tools/srjt_profile.py diff  [--dir DIR] [BASE CAND]
+
+``diff`` with no positional arguments picks the two newest profiles
+sharing a plan fingerprint (the cross-run EXPLAIN ANALYZE comparison);
+with explicit paths it diffs exactly those.  Exit code 0 on success, 2 on
+usage errors (empty store, no fingerprint pair).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from spark_rapids_jni_tpu.utils import profile  # noqa: E402
+
+
+def _dir_of(args) -> str:
+    d = args.dir or profile.config.profile_dir
+    if not d:
+        print("profile store dir not set (use --dir or SRJT_PROFILE_DIR)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return d
+
+
+def cmd_list(args) -> int:
+    d = _dir_of(args)
+    paths = profile.list_profiles(d)
+    for p in paths:
+        try:
+            prof = profile.read(p)
+        except (OSError, ValueError) as e:
+            print(f"{os.path.basename(p)}  <unreadable: {e}>")
+            continue
+        nex = len(prof.get("exchanges", ()))
+        print(f"{os.path.basename(p)}  name={prof.get('name', '')!r} "
+              f"wall={prof.get('wall_s')}s nodes={len(prof.get('nodes', ()))} "
+              f"exchanges={nex}")
+    summ = profile.store_summary(d)
+    print(f"-- {summ['profiles']} profiles, "
+          f"top_exchange_skew={summ['top_exchange_skew']}, "
+          f"chunk_latency_p99_s={summ['chunk_latency_p99_s']}")
+    return 0
+
+
+def _resolve(d: str, spec: str | None) -> str:
+    """A path, or a negative index into the chronological store (-1 =
+    newest); default newest."""
+    if spec and not spec.lstrip("-").isdigit():
+        return spec if os.path.sep in spec else os.path.join(d, spec)
+    paths = profile.list_profiles(d)
+    if not paths:
+        print(f"no profiles in {d}", file=sys.stderr)
+        raise SystemExit(2)
+    idx = int(spec) if spec else -1
+    try:
+        return paths[idx]
+    except IndexError:
+        print(f"index {idx} out of range ({len(paths)} profiles)",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+def cmd_show(args) -> int:
+    path = _resolve(_dir_of(args), args.path)
+    print(json.dumps(profile.read(path), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_diff(args) -> int:
+    d = _dir_of(args)
+    if args.base and args.cand:
+        base = _resolve(d, args.base)
+        cand = _resolve(d, args.cand)
+    else:
+        # newest pair sharing a fingerprint: the cross-run comparison
+        paths = profile.list_profiles(d)
+        by_fp: dict[str, list] = {}
+        for p in paths:
+            try:
+                fp = profile.read(p).get("fingerprint", "")
+            except (OSError, ValueError):
+                continue
+            by_fp.setdefault(fp, []).append(p)
+        pair = None
+        for p in reversed(paths):  # newest fingerprint with >= 2 runs wins
+            fp = next((f for f, ps in by_fp.items() if p in ps), "")
+            if len(by_fp.get(fp, ())) >= 2:
+                pair = by_fp[fp][-2:]
+                break
+        if pair is None:
+            print("no two profiles share a fingerprint; pass BASE CAND "
+                  "explicitly", file=sys.stderr)
+            return 2
+        base, cand = pair
+    d_out = profile.diff(base, cand)
+    if args.json:
+        print(json.dumps(d_out, indent=2, sort_keys=True))
+    else:
+        print(profile.render_diff(d_out))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srjt_profile", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default=None,
+                    help="profile store directory (default SRJT_PROFILE_DIR)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="one line per stored profile + store summary")
+    p_show = sub.add_parser("show", help="pretty-print one profile")
+    p_show.add_argument("path", nargs="?", default=None,
+                        help="path, filename, or negative index (-1 = newest)")
+    p_diff = sub.add_parser("diff",
+                            help="per-node deltas between two runs")
+    p_diff.add_argument("base", nargs="?", default=None)
+    p_diff.add_argument("cand", nargs="?", default=None)
+    p_diff.add_argument("--json", action="store_true",
+                        help="emit the structured diff instead of the table")
+    args = ap.parse_args(argv)
+    return {"list": cmd_list, "show": cmd_show, "diff": cmd_diff}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    try:
+        code = main()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe mid-print: normal exit,
+        # but devnull stdout first so interpreter teardown can't re-raise
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    raise SystemExit(code)
