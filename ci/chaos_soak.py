@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Chaos soak: the fault-injection matrix against the real pipeline.
+
+The robustness acceptance test (docs/ROBUSTNESS.md): build the bench
+warehouse, compute oracle results with no faults armed, then re-run the
+same plans under a rotating ``SRJT_FAULTS`` schedule covering every
+injection site x kind.  Each run must end one of exactly two ways:
+
+- **parity** — the recovery layer absorbed the fault (retry, interpreted
+  fallback, exchange degradation ladder) and the result matches the
+  oracle bit-for-bit after key-sorting; or
+- **typed error** — a classified, non-fatal ``utils.errors`` kind
+  (transient / resource / cancelled) surfaced within the deadline.
+
+Anything else fails the soak: a fatal/unclassified error, a hang (the
+whole script runs under ``timeout`` in ci/nightly.sh), a result mismatch,
+a leaked prefetch thread (``io.prefetch.reap_timeouts`` must stay 0), or
+an orphaned spill file.
+
+Run directly::
+
+    JAX_PLATFORMS=cpu python ci/chaos_soak.py
+    python ci/chaos_soak.py --rounds 2 --devices 2   # more soak, exchange on
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the soak schedule: every site, both deterministic-nth and every-time
+# rules, all three kinds.  timeout-kind sleeps are tiny (faults.HANG_S)
+# so the soak stays fast; the point is that deadline plumbing engages.
+SCHEDULE = [
+    "parquet.chunk:1:io_error",
+    "parquet.chunk:*:io_error",
+    "parquet.chunk:2:oom",
+    "parquet.prefetch:1:io_error",
+    "parquet.prefetch:*:io_error",
+    "staging.transfer:1:oom",
+    "staging.transfer:2:io_error",
+    "exchange.dispatch:1:oom",
+    "exchange.dispatch:*:oom",
+    "spill.write:1:io_error",
+    "bridge.op:1:io_error",
+    "parquet.chunk:1:timeout",
+    "parquet.chunk:3:io_error,staging.transfer:1:oom",
+]
+
+
+def _sorted_columns(table, key):
+    import numpy as np
+    a = np.asarray(table.column(key).data)
+    order = np.argsort(a, kind="stable")
+    return [np.asarray(c.data)[order] for c in table.columns]
+
+
+def _parity(base, out, key) -> bool:
+    import numpy as np
+    if base.num_rows != out.num_rows or base.num_columns != out.num_columns:
+        return False
+    for x, y in zip(_sorted_columns(base, key), _sorted_columns(out, key)):
+        if not np.allclose(np.asarray(x, np.float64),
+                           np.asarray(y, np.float64)):
+            return False
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="full passes over the fault schedule")
+    ap.add_argument("--rows", type=int, default=120_000)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="virtual CPU device count (0 = leave as-is)")
+    args = ap.parse_args(argv)
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("SRJT_FAULTS", None)
+    # chunk-boundary deadline: generous enough for cold jit compiles, small
+    # enough that a real hang converts to a typed timeout well before the
+    # nightly `timeout` wrapper SIGKILLs the soak
+    os.environ["SRJT_QUERY_TIMEOUT_S"] = "120"
+    os.environ["SRJT_RETRY_BACKOFF_S"] = "0.001"
+
+    import numpy as np
+
+    import bench
+    from spark_rapids_jni_tpu.engine import execute, optimize
+    from spark_rapids_jni_tpu.utils import errors, faults, tracing
+    from spark_rapids_jni_tpu.utils.config import refresh
+
+    refresh()
+    rng = np.random.default_rng(7)
+    root = tempfile.mkdtemp(prefix="srjt-chaos-")
+    bench._pipeline_warehouse(root, args.rows, rng)
+    q5, chunked = bench._pipeline_plans(root, chunk_bytes=256_000)
+    plans = [("q5", optimize(q5), "s_mgr"),
+             ("chunked", optimize(chunked), "ss_store_sk")]
+
+    oracle = {name: execute(opt) for name, opt, _ in plans}
+    thread_floor = threading.active_count()
+
+    failures: list[str] = []
+    runs = outcomes_parity = outcomes_typed = 0
+    t_start = time.monotonic()
+    for rnd in range(args.rounds):
+        for spec in SCHEDULE:
+            os.environ["SRJT_FAULTS"] = spec
+            refresh()
+            for name, opt, key in plans:
+                faults.reset()
+                runs += 1
+                tag = f"round{rnd} [{spec}] {name}"
+                try:
+                    out = execute(opt)
+                except Exception as e:  # noqa: BLE001 — the soak classifies
+                    kind, _ = errors.classify(e)
+                    if kind == errors.KIND_FATAL:
+                        failures.append(
+                            f"{tag}: FATAL {type(e).__name__}: {e}")
+                    else:
+                        outcomes_typed += 1
+                        print(f"  {tag}: typed error "
+                              f"({kind}) {type(e).__name__}")
+                    continue
+                if _parity(oracle[name], out, key):
+                    outcomes_parity += 1
+                else:
+                    failures.append(f"{tag}: result diverged from oracle")
+    os.environ.pop("SRJT_FAULTS", None)
+    refresh()
+    faults.reset()
+
+    # spill path under injection, with a real spill_dir: the sweep plus
+    # finalizers must leave the directory empty
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+    from spark_rapids_jni_tpu.parallel.spill import shuffle_table_spilled
+    sd = tempfile.mkdtemp(prefix="srjt-chaos-spill-")
+    st = Table([Column.from_numpy(
+                    rng.integers(0, 64, 50_000).astype("int64")),
+                Column.from_numpy(
+                    rng.integers(-99, 99, 50_000).astype("int64"))],
+               ["k", "v"])
+    os.environ["SRJT_FAULTS"] = "spill.write:1:io_error"
+    refresh()
+    faults.reset()
+    spilled = shuffle_table_spilled(st, make_mesh(), ["k"],
+                                    hbm_budget_bytes=1 << 18, spill_dir=sd)
+    if spilled.num_rows != st.num_rows:
+        failures.append("spill: row count diverged under injection")
+    del spilled  # finalizers unlink the memmaps
+    import gc
+    gc.collect()
+    left = [n for n in os.listdir(sd) if n.startswith("spill-")]
+    if left:
+        failures.append(f"spill: {len(left)} file(s) left in {sd}: {left}")
+    os.environ.pop("SRJT_FAULTS", None)
+    refresh()
+
+    # leak checks: every prefetch producer must have been reaped inside
+    # its join window, and no soak run may leave a live worker behind
+    reaps = tracing.counters_snapshot("io.prefetch.reap_timeouts")
+    if any(reaps.values()):
+        failures.append(f"prefetch reap timeouts: {reaps}")
+    time.sleep(0.2)  # producers parked on a full queue exit on drain/close
+    leaked = threading.active_count() - thread_floor
+    if leaked > 0:
+        names = [t.name for t in threading.enumerate()]
+        failures.append(f"{leaked} leaked thread(s): {names}")
+
+    wall = time.monotonic() - t_start
+    print(f"chaos soak: {runs} runs in {wall:.1f}s — "
+          f"{outcomes_parity} parity, {outcomes_typed} typed errors, "
+          f"{len(failures)} failure(s)")
+    counters = tracing.counters_snapshot("engine.")
+    for k in sorted(counters):
+        if k.startswith(("engine.retries", "engine.degraded",
+                         "engine.errors")):
+            print(f"  {k} = {counters[k]}")
+    for f in failures:
+        print(f"  FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,11 @@ ci/premerge.sh
 JAX_PLATFORMS=cpu python tools/srjt_lint.py --segments --full \
     --baseline ci/lint-baseline.json
 
+# chaos soak: the fault-injection matrix against the pipeline plans
+# (docs/ROBUSTNESS.md).  `timeout` is the outermost hang detector — a soak
+# that can't finish inside 15 minutes IS a robustness failure.
+JAX_PLATFORMS=cpu timeout 900 python ci/chaos_soak.py --devices 2
+
 # benchmarks (runs on whatever backend jax selects; TPU when present)
 python bench.py | tee target/bench-nightly.json
 

@@ -22,6 +22,7 @@ from . import protocol as P
 from . import shm as shmlib
 from ..columnar import Column, Table
 from ..dtypes import DType, TypeId
+from ..utils.errors import BridgeTimeoutError, from_wire
 
 
 def spawn_server(sock_path: str, env: dict | None = None,
@@ -70,7 +71,10 @@ def _bridge_error(body: bytes) -> Exception:
     Structured plan-verification replies (JSON with ``error:
     plan_verification``) reconstruct the server-side
     ``PlanVerificationError`` — code and node path intact, so callers can
-    dispatch on ``e.code`` — everything else stays the flat RuntimeError."""
+    dispatch on ``e.code``.  Taxonomized replies (``error: taxonomy``,
+    utils/errors.py) reconstruct the typed engine exception — kind and
+    retryable bit intact, so callers can retry transients or degrade on
+    resource exhaustion.  Everything else stays the flat RuntimeError."""
     if body[:1] == b"{":
         try:
             import json
@@ -80,12 +84,22 @@ def _bridge_error(body: bytes) -> Exception:
         if isinstance(doc, dict) and doc.get("error") == "plan_verification":
             from ..engine.verify import PlanVerificationError
             return PlanVerificationError.from_dict(doc)
+        if isinstance(doc, dict) and doc.get("error") == "taxonomy":
+            return from_wire(doc)
     return RuntimeError(f"bridge error: {body.decode()}")
 
 
 class BridgeClient:
-    def __init__(self, sock_path: str):
+    def __init__(self, sock_path: str, timeout: float | None = None):
+        from ..utils.config import config
+        # per-op socket deadline: a wedged server can no longer hang the
+        # client forever.  None/0 restores the unbounded pre-hardening
+        # behavior; the default tracks SRJT_BRIDGE_TIMEOUT_S.
+        if timeout is None:
+            timeout = config.bridge_timeout_s
+        self._timeout = timeout if timeout and timeout > 0 else None
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(self._timeout)
         self.sock.connect(sock_path)
         # every request/reply exchange; whole-plan dispatch exists to keep
         # this flat where per-op traffic grows with plan size
@@ -93,9 +107,33 @@ class BridgeClient:
 
     # -- plumbing ----------------------------------------------------------
     def _call(self, opcode: int, payload: bytes = b"") -> bytes:
+        if self.sock is None:
+            # deliberately NOT a retryable type: resending on a client that
+            # already timed out would be exactly the desync a retry layer
+            # must never be invited into
+            raise RuntimeError(
+                "bridge client unusable: a previous op timed out and the "
+                "connection was closed (open a new BridgeClient)")
         self.round_trips += 1
-        P.send_msg(self.sock, opcode, payload)
-        status, body = P.recv_msg(self.sock)
+        # PLAN_EXECUTE runs as long as the query does — unbounded by
+        # design; SRJT_QUERY_TIMEOUT_S / OP_CANCEL bound it cooperatively.
+        # Every other op is a bounded handle exchange and keeps the
+        # per-op deadline.
+        self.sock.settimeout(None if opcode == P.OP_PLAN_EXECUTE
+                             else self._timeout)
+        try:
+            P.send_msg(self.sock, opcode, payload)
+            status, body = P.recv_msg(self.sock)
+        except (socket.timeout, P.FrameTimeoutError) as e:
+            # the server's late reply may still land on this socket; the
+            # next _call would read that stale frame as ITS reply.  Poison
+            # the client: close now, force an explicit reconnect before
+            # any retry.
+            self.close()
+            raise BridgeTimeoutError(
+                f"bridge op {opcode} exceeded the {self._timeout}s "
+                "socket deadline (SRJT_BRIDGE_TIMEOUT_S); connection "
+                "closed — reconnect before retrying") from e
         if status != P.STATUS_OK:
             raise _bridge_error(body)
         return body
@@ -105,11 +143,21 @@ class BridgeClient:
             raise RuntimeError("bridge server returned a bad ping reply")
 
     def close(self) -> None:
-        self.sock.close()
+        if self.sock is not None:
+            self.sock.close()
+            self.sock = None
 
     def shutdown_server(self) -> None:
         self._call(P.OP_SHUTDOWN)
         self.close()
+
+    def cancel(self) -> int:
+        """Flip the cancellation token of every in-flight PLAN_EXECUTE on
+        the server; returns how many were cancelled.  Issue this from a
+        SECOND connection — a connection blocked awaiting its own
+        PLAN_EXECUTE reply cannot also carry the cancel."""
+        (n,) = struct.unpack("<I", self._call(P.OP_CANCEL))
+        return n
 
     # -- handle ops ----------------------------------------------------------
     def import_table(self, table: Table) -> int:

@@ -70,6 +70,10 @@ OP_PLAN_EXECUTE = 23   # [u32 plen][plan json utf-8] -> [u32 n][u64 th...]
 #                        serialized engine plan DAG (engine/plan.py
 #                        canonical JSON); the server optimizes/caches/
 #                        executes it and returns result table handle(s)
+OP_CANCEL = 24         # -> [u32 n] flips the cancellation token of every
+#                        in-flight PLAN_EXECUTE on the server (n = how
+#                        many); handled OUTSIDE the dispatch lock, like
+#                        OP_SHUTDOWN, so it can interrupt a running query
 
 # OP_GROUPBY aggregation codes
 AGG_SUM, AGG_COUNT, AGG_MIN, AGG_MAX, AGG_MEAN = 0, 1, 2, 3, 4
@@ -92,10 +96,28 @@ COLDESC = struct.Struct("<iiqBQQQQ")      # typeid, scale, n, hasvalid, 4 bufs
 STRDESC = struct.Struct("<QQ")            # offsets buffer (off, len)
 
 
+class FrameTimeoutError(ConnectionError):
+    """Per-op deadline expired MID-FRAME: bytes of the message already
+    moved, so the stream is desynced and the connection unusable — unlike
+    an idle ``socket.timeout`` (no bytes read), where the caller may
+    simply wait again.  A ``ConnectionError`` subclass so every existing
+    dead-peer handler treats it as exactly that."""
+
+
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if buf:
+                # deadline hit mid-frame: the stream is desynced — the
+                # remaining bytes may arrive later and would be parsed as
+                # a new header.  Only an *idle* timeout (no bytes read) is
+                # re-raised for the caller to wait again.
+                raise FrameTimeoutError(
+                    "bridge frame timed out mid-message") from None
+            raise
         if not chunk:
             raise ConnectionError("bridge peer closed the socket")
         buf.extend(chunk)
@@ -113,5 +135,10 @@ def recv_msg(sock: socket.socket) -> tuple[int, bytes]:
         # a zero-length frame can't carry an opcode; treat the peer as broken
         # rather than letting an IndexError escape the dispatch loop
         raise ConnectionError("malformed bridge frame (empty body)")
-    body = recv_exact(sock, body_len)
+    try:
+        body = recv_exact(sock, body_len)
+    except socket.timeout:
+        # header arrived but the body didn't: mid-message stall, not idle
+        raise FrameTimeoutError(
+            "bridge frame timed out mid-message") from None
     return body[0], body[1:]

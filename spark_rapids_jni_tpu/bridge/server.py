@@ -41,14 +41,19 @@ def _error_body(e: Exception) -> bytes:
 
     Plan-verification failures ship as a JSON document carrying the check
     code + node path (the client reconstructs a ``PlanVerificationError``);
-    everything else keeps the plain ``Type: message`` text the error
-    discipline has always used."""
+    everything else ships the error-taxonomy JSON (kind + retryable bit +
+    type + message, utils.errors.to_wire) so the client can reconstruct a
+    typed error and its retry layer can tell transient from fatal without
+    string-matching."""
     from ..engine.verify import PlanVerificationError
     if isinstance(e, PlanVerificationError):
         import json
         return json.dumps({"error": "plan_verification",
                            **e.to_dict()}).encode()
-    return f"{type(e).__name__}: {e}".encode()
+    import json
+
+    from ..utils import errors
+    return json.dumps(errors.to_wire(e)).encode()
 
 
 class HandleTable:
@@ -147,6 +152,11 @@ class BridgeServer:
         self._shutdown = threading.Event()
         self._conns_lock = threading.Lock()
         self._conns: set[socket.socket] = set()
+        # cancellation registry: live CancelTokens of in-flight
+        # PLAN_EXECUTEs; OP_CANCEL (handled outside the dispatch lock)
+        # flips every one of them
+        self._tokens_lock = threading.Lock()
+        self._active_tokens: set[object] = set()
         # observability (SURVEY §5 metrics/logging): per-op counters the
         # client reads over OP_METRICS; slf4j-analog logger from utils.config
         self._metrics = {"ops": {}, "errors": 0, "busy_s": 0.0}
@@ -434,22 +444,45 @@ class BridgeServer:
             from ..engine import PlanCache
             self._plan_cache = PlanCache()
         from ..utils import metrics
+        from ..utils.config import config as _cfg
+        from ..utils.errors import CancelToken
         stats: dict = {}
-        # plan-cache lookup runs inside the query context so its hit/miss
-        # is attributed to the query that caused it (OP_METRICS `queries`)
-        with metrics.query(f"plan:{plan.fingerprint()[:12]}") as qm:
-            compiled = self._plan_cache.get(plan)
-            out = compiled.execute(stats=stats)
-            if qm is not None:
-                qm.note_stats(stats)
+        # per-query cancellation: registered while the plan runs so a
+        # concurrent OP_CANCEL (or the SRJT_QUERY_TIMEOUT_S deadline) can
+        # stop it at the next chunk boundary
+        tok = CancelToken(_cfg.query_timeout_s or None)
+        with self._tokens_lock:
+            self._active_tokens.add(tok)
+        try:
+            # plan-cache lookup runs inside the query context so its
+            # hit/miss is attributed to the query that caused it
+            # (OP_METRICS `queries`)
+            with metrics.query(f"plan:{plan.fingerprint()[:12]}") as qm:
+                compiled = self._plan_cache.get(plan)
+                out = compiled.execute(stats=stats, cancel=tok)
+                if qm is not None:
+                    qm.note_stats(stats)
+        finally:
+            with self._tokens_lock:
+                self._active_tokens.discard(tok)
         self._last_plan_stats = stats
         if qm is not None:
             self._last_plan_summary = qm.summary()
         h = self.handles.put(out)
         return struct.pack("<I", 1) + struct.pack("<Q", h)
 
+    def _cancel_active(self) -> int:
+        """Flip every in-flight PLAN_EXECUTE's token; returns how many."""
+        with self._tokens_lock:
+            toks = list(self._active_tokens)
+        for t in toks:
+            t.cancel("cancelled via bridge OP_CANCEL")
+        return len(toks)
+
     # -- dispatch loop -----------------------------------------------------
     def _dispatch(self, opcode: int, payload: bytes) -> bytes:
+        from ..utils import faults
+        faults.check("bridge.op")
         if opcode == P.OP_PING:
             return b"pong"
         if opcode == P.OP_IMPORT_TABLE:
@@ -596,16 +629,34 @@ class BridgeServer:
                 self._conns.discard(conn)
 
     def _client_loop(self, conn: socket.socket) -> None:
+        from ..utils.config import config as _cfg
+        # per-op socket deadline (SRJT_BRIDGE_TIMEOUT_S): a wedged peer
+        # can't park this worker thread in recv forever.  An idle timeout
+        # between requests is not an error — loop and wait again.
+        conn.settimeout(_cfg.bridge_timeout_s or None)
         with conn:
             while not self._shutdown.is_set():
                 try:
                     opcode, payload = P.recv_msg(conn)
+                except socket.timeout:
+                    continue  # idle connection; re-check shutdown and wait
                 except ConnectionError:
                     return  # client went away; others keep running
+                if opcode == P.OP_CANCEL:
+                    # outside the dispatch lock, like OP_SHUTDOWN: the
+                    # whole point is to interrupt a PLAN_EXECUTE that is
+                    # holding that lock right now
+                    n = self._cancel_active()
+                    self._log.info("OP_CANCEL flipped %d token(s)", n)
+                    try:
+                        P.send_msg(conn, P.STATUS_OK, struct.pack("<I", n))
+                    except OSError:  # dead OR slow peer (send deadline)
+                        return
+                    continue
                 if opcode == P.OP_SHUTDOWN:
                     try:
                         P.send_msg(conn, P.STATUS_OK)
-                    except (BrokenPipeError, ConnectionError):
+                    except OSError:  # dead OR slow peer (send deadline)
                         pass
                     self._shutdown.set()
                     # unblock the accept() loop
@@ -633,8 +684,11 @@ class BridgeServer:
                     status, resp = P.STATUS_OK, out
                 try:
                     P.send_msg(conn, status, resp)
-                except (BrokenPipeError, ConnectionError):
-                    return  # client died mid-reply; keep serving others
+                except OSError:
+                    # client died mid-reply, or a slow client tripped the
+                    # send deadline (socket.timeout is an OSError): drop
+                    # this connection cleanly, keep serving others
+                    return
 
 
 def serve(sock_path: str) -> None:

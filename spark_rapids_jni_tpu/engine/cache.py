@@ -44,9 +44,9 @@ class CompiledPlan:
         self.optimized = optimized
         self.executions = 0
 
-    def execute(self, stats: Optional[dict] = None):
+    def execute(self, stats: Optional[dict] = None, cancel=None):
         self.executions += 1
-        return execute(self.optimized, stats=stats)
+        return execute(self.optimized, stats=stats, cancel=cancel)
 
 
 class PlanCache:

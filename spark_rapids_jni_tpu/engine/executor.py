@@ -24,10 +24,12 @@ import jax.numpy as jnp
 
 from ..columnar import Table
 from ..utils import metrics, timeline
+from ..utils.errors import CancelToken, classify
 from ..utils.memory import table_nbytes
 from ..utils.tracing import op_scope
 from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
                    Project, Scan, Sort, TopK, node_label)
+from .recovery import RecoveryPolicy, query_cancel_token
 
 #: aggregate ops with a (merge-op) decomposition usable for per-chunk
 #: partials; value = op that combines partial results
@@ -114,16 +116,21 @@ class _ExecCtx:
     (engine/segment.py) instead of interpreting node-by-node.
     ``prefetch``: chunked-scan pipeline depth — the producer thread decodes
     and stages chunk k+1..k+prefetch while chunk k computes (0 = serial).
+    ``recovery``: the query's RecoveryPolicy (retry/degradation ladder +
+    cancellation token), checked at every chunk boundary.
     """
 
-    __slots__ = ("fuse", "prefetch", "nparents", "segments")
+    __slots__ = ("fuse", "prefetch", "nparents", "segments", "recovery")
 
-    def __init__(self, root: PlanNode, fuse: bool, prefetch: int):
+    def __init__(self, root: PlanNode, fuse: bool, prefetch: int,
+                 recovery: Optional[RecoveryPolicy] = None):
         from .segment import parent_counts
         self.fuse = fuse
         self.prefetch = max(0, int(prefetch))
         self.nparents = parent_counts(root) if fuse else {}
         self.segments: dict = {}  # id(top node) -> Segment | None
+        self.recovery = recovery if recovery is not None \
+            else RecoveryPolicy()
 
     def segment_for(self, node: PlanNode):
         if not self.fuse:
@@ -192,7 +199,8 @@ def _stream_scan_of(agg: Aggregate) -> Optional[Scan]:
 
 # -- the walk --------------------------------------------------------------
 
-def _scan_table(scan: Scan, stats: dict) -> Table:
+def _scan_table(scan: Scan, stats: dict,
+                ctx: Optional[_ExecCtx] = None) -> Table:
     if scan.format == "orc":
         from ..io import read_orc
         return read_orc(scan.path, list(scan.columns)
@@ -207,7 +215,8 @@ def _scan_table(scan: Scan, stats: dict) -> Table:
     from ..ops.selection import concat_tables
     reader = ParquetChunkedReader(
         scan.path, pass_read_limit=scan.chunk_bytes or (64 << 20),
-        columns=cols, predicate=scan.predicate)
+        columns=cols, predicate=scan.predicate,
+        cancel=ctx.recovery.cancel if ctx is not None else None)
     parts = list(reader)
     stats["row_groups_pruned"] += reader.groups_pruned
     stats["row_groups_read"] += reader.groups_read
@@ -263,7 +272,7 @@ def _exec_segment(seg, memo: dict, stats: dict, ctx: _ExecCtx,
 
 
 def _exec_scan(node: Scan, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
-    return _scan_table(node, stats)
+    return _scan_table(node, stats, ctx)
 
 
 def _exec_filter(node: Filter, memo: dict, stats: dict,
@@ -294,7 +303,29 @@ def _exec_aggregate(node: Aggregate, memo: dict, stats: dict,
                     ctx: _ExecCtx) -> Table:
     scan = _stream_scan_of(node)
     if scan is not None:
-        return _exec_streamed(node, scan, memo, stats, ctx)
+        # scan-independent subtrees go into the shared memo BEFORE the
+        # stats snapshot: a degraded re-run finds them memoized and skips
+        # them, so their counts must survive the restore below
+        _precompute_independent(node.child, scan, memo, stats, ctx)
+        snap = {k: (list(v) if isinstance(v, list) else v)
+                for k, v in stats.items()}
+        try:
+            return _exec_streamed(node, scan, memo, stats, ctx)
+        except Exception as e:
+            # resource exhaustion on the fused/staged stream degrades to
+            # the interpreted per-chunk path — the always-correct fallback
+            # with a smaller device footprint (no padded shape buckets, no
+            # staged double-buffering of device chunks)
+            if not ctx.recovery.can_degrade(e):
+                raise
+            # drop the failed attempt's partial evidence (chunks,
+            # row-group counts, fused_segments, chain nodes) so the
+            # re-run's accounting isn't double-counted
+            stats.clear()
+            stats.update(snap)
+            ctx.recovery.degrade("stream-interpreted", e, stats)
+            return _exec_streamed(node, scan, memo, stats, ctx,
+                                  force_interp=True)
     seg = ctx.segment_for(node)
     if seg is not None:
         return _exec_segment(seg, memo, stats, ctx, node)
@@ -325,7 +356,15 @@ def _exec_exchange(node: Exchange, memo: dict, stats: dict,
     """Data movement as a plan node: replicate (broadcast) or re-place
     (hash shuffle) the child's rows across the device mesh.  Output row
     ORDER is not preserved by the hash kind — exchanges only feed
-    order-insensitive consumers (joins, aggregates)."""
+    order-insensitive consumers (joins, aggregates).
+
+    Resource exhaustion walks a degradation ladder, each rung logged and
+    counted (engine/recovery.py): full capacity → halved chunk capacity →
+    spilled shuffle (parallel/spill.py, host-buffered passes) →
+    passthrough.  The last rung is content-equivalent — ``_hash_exchange``
+    returns the full concatenated table either way, so eliding it loses
+    only device placement, which downstream ops recompute from data.
+    Transient dispatch failures retry under the policy's backoff first."""
     child = _exec(node.child, memo, stats, ctx)
     # counted before any degenerate early-out (1 device, 0 rows) so the
     # executed count always equals the static verify.plan_exchanges census
@@ -333,7 +372,28 @@ def _exec_exchange(node: Exchange, memo: dict, stats: dict,
     stats["exchanges"] += 1
     if node.kind == "broadcast":
         return _broadcast_exchange(node, child)
-    return _hash_exchange(node, child, ctx)
+    rp = ctx.recovery
+    try:
+        return rp.retry("exchange.dispatch",
+                        lambda: _hash_exchange(node, child, ctx))
+    except Exception as e:
+        if not rp.can_degrade(e):
+            raise
+        rp.degrade("exchange-halved", e, stats)
+    try:
+        return _hash_exchange(node, child, ctx,
+                              chunk_rows=_EXCHANGE_CHUNK_ROWS // 2)
+    except Exception as e:
+        if not rp.can_degrade(e):
+            raise
+        rp.degrade("exchange-spilled", e, stats)
+    try:
+        return _spilled_exchange(node, child, ctx)
+    except Exception as e:
+        if not rp.can_degrade(e):
+            raise
+        rp.degrade("exchange-passthrough", e, stats)
+        return child
 
 
 def _broadcast_exchange(node: Exchange, table: Table) -> Table:
@@ -359,7 +419,8 @@ def _broadcast_exchange(node: Exchange, table: Table) -> Table:
         return broadcast_table(table, make_mesh(ndev))
 
 
-def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
+def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
+                   chunk_rows: int = _EXCHANGE_CHUNK_ROWS) -> Table:
     """Streamed two-phase hash shuffle of ``table`` over the full mesh.
 
     Chunks of ``_EXCHANGE_CHUNK_ROWS`` stream through
@@ -403,7 +464,7 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
 
     mesh = make_mesh(ndev)
     rows = table.num_rows
-    nchunks = -(-rows // _EXCHANGE_CHUNK_ROWS)
+    nchunks = -(-rows // chunk_rows)
     row_spec = NamedSharding(mesh, PartitionSpec(ROW_AXIS))
     layout = fixed_width_layout(table.dtypes())
 
@@ -430,9 +491,10 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
 
     def chunk_stream():
         for i in range(nchunks):
-            lo = i * _EXCHANGE_CHUNK_ROWS
+            ctx.recovery.checkpoint()
+            lo = i * chunk_rows
             yield staged(slice_table(table, lo,
-                                     min(rows - lo, _EXCHANGE_CHUNK_ROWS)))
+                                     min(rows - lo, chunk_rows)))
 
     tl = timeline.enabled()
     fbase = timeline.new_flow_base() if tl else 0
@@ -531,6 +593,41 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
     return result
 
 
+def _spilled_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
+    """Degraded exchange via ``shuffle_table_spilled``: bounded device
+    passes, host-resident result.  Row placement matches the padded path
+    (Spark HashPartitioning over original UTF-8 bytes for string keys);
+    output order is pass-major — exchanges only feed order-insensitive
+    consumers, so the content multiset is what matters."""
+    import jax
+
+    from ..parallel import shuffle as sh
+    from ..parallel.mesh import make_mesh
+    from ..parallel.spill import shuffle_table_spilled
+
+    ndev = len(jax.devices())
+    if ndev <= 1 or table.num_rows == 0:
+        return table
+    plan = None
+    keys = list(node.keys)
+    key_specs = None
+    if any(c.dtype.is_string for c in table.columns):
+        from ..parallel.stringplane import explode_strings, reassemble_strings
+        table, plan = explode_strings(table)
+        key_specs = sh.key_specs_for(table, keys, plan)
+    # half the table's footprint as the pass budget: small exchanges run
+    # one pass, oversize ones split — the degraded path exists because the
+    # full-capacity dispatch just OOMed, so never size to the whole table
+    budget = max(1 << 20, table_nbytes(table) // 2)
+    metrics.count("engine.exchange.spilled_reroutes")
+    result = shuffle_table_spilled(table, make_mesh(ndev), keys,
+                                   hbm_budget_bytes=budget,
+                                   key_specs=key_specs)
+    if plan is not None:
+        result = reassemble_strings(result, plan)
+    return result
+
+
 def _exec(node: PlanNode, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     if id(node) in memo:
         return memo[id(node)]
@@ -588,7 +685,8 @@ def _get_builds(joins: tuple, build_tables: tuple) -> tuple:
 
 
 def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
-                   stats: dict, ctx: _ExecCtx) -> Table:
+                   stats: dict, ctx: _ExecCtx,
+                   force_interp: bool = False) -> Table:
     """Per-chunk partial aggregation over the one chunked scan.
 
     Three compounding upgrades over the PR 1 interpreter loop:
@@ -620,12 +718,13 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
     cols = list(scan.columns) if scan.columns else None
     reader = ParquetChunkedReader(
         scan.path, pass_read_limit=scan.chunk_bytes,
-        columns=cols, predicate=scan.predicate, prefetch=ctx.prefetch)
+        columns=cols, predicate=scan.predicate, prefetch=ctx.prefetch,
+        cancel=ctx.recovery.cancel)
     stats["streamed"] = True
     stats["pipelined"] = ctx.prefetch > 0
 
     seg = None
-    if ctx.fuse:
+    if ctx.fuse and not force_interp:
         cand = sg.build_stream_segment(agg, scan, ctx.nparents,
                                        fuse_join=config.fuse_join)
         if cand is not None and cand.input is scan \
@@ -658,6 +757,7 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                 from ..ops.selection import slice_table
                 seg = None
                 for chunk, nvalid in _chain_one(first, it):
+                    ctx.recovery.checkpoint()
                     if nvalid < chunk.num_rows:
                         chunk = slice_table(chunk, 0, nvalid)
                     partials.extend(_stream_partial(agg, scan, chunk, memo,
@@ -668,6 +768,7 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                 preps = first_preps
                 for chunk, nvalid in _chain_one(first, it) \
                         if first is not None else ():
+                    ctx.recovery.checkpoint()
                     stats["chunks"] += 1
                     tc0 = time.perf_counter() if qm is not None else 0.0
                     if fused:  # chunks after the first hit the cache
@@ -692,6 +793,7 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                     stats["fused_segments"] += 1
         else:
             for chunk in reader:
+                ctx.recovery.checkpoint()
                 partials.extend(_stream_partial(agg, scan, chunk, memo,
                                                 stats, ctx))
     finally:
@@ -795,7 +897,8 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     cols = list(scan.columns) if scan.columns else None
     reader = ParquetChunkedReader(
         scan.path, pass_read_limit=scan.chunk_bytes,
-        columns=cols, predicate=scan.predicate, prefetch=ctx.prefetch)
+        columns=cols, predicate=scan.predicate, prefetch=ctx.prefetch,
+        cancel=ctx.recovery.cancel)
     stats["streamed"] = True
     stats["topk"] = True
     stats["pipelined"] = ctx.prefetch > 0
@@ -806,6 +909,7 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     qm = metrics.current()
     try:
         for chunk in reader:
+            ctx.recovery.checkpoint()
             stats["chunks"] += 1
             tc0 = time.perf_counter() if qm is not None else 0.0
             if qm is not None:
@@ -872,17 +976,29 @@ _EXEC_DISPATCH = {
 
 def execute(plan: PlanNode, stats: Optional[dict] = None,
             fused: Optional[bool] = None,
-            prefetch: Optional[int] = None) -> Table:
+            prefetch: Optional[int] = None,
+            cancel: Optional[CancelToken] = None) -> Table:
     """Run ``plan`` against the local io/ops layers; returns the result.
 
     ``stats`` (optional dict) is updated in place with execution evidence:
     ``row_groups_pruned``/``row_groups_read`` (scan pruning), ``chunks``,
     ``streamed`` and ``pipelined`` (partial-aggregation path), ``nodes``
-    executed, ``fused_segments`` compiled-segment runs.
+    executed, ``fused_segments`` compiled-segment runs, ``degradations``
+    (ladder steps taken, engine/recovery.py).
 
     ``fused``/``prefetch`` override the ``SRJT_FUSE``/``SRJT_PREFETCH``
     config defaults for this execution (the bench harness compares the
     node-by-node interpreter against the fused pipeline this way).
+
+    ``cancel`` (utils.errors.CancelToken) makes the execution cooperatively
+    cancellable: chunk boundaries and the prefetch producer poll it, and a
+    tripped token unwinds with ``QueryCancelledError``/``QueryTimeoutError``
+    through the readers' ``close()`` machinery.  With no token given,
+    ``SRJT_QUERY_TIMEOUT_S > 0`` installs a deadline-only token.
+
+    Failures are classified (utils.errors) on the way out: the query
+    summary carries an ``outcome`` record and ``engine.errors.<kind>``
+    ticks — EXPLAIN ANALYZE and the profile store render both.
     """
     from ..utils.config import config
     if stats is None:
@@ -890,10 +1006,14 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
     else:
         for k, v in new_stats().items():
             stats.setdefault(k, v)
+    if cancel is None:
+        cancel = query_cancel_token()
+    recovery = RecoveryPolicy(cancel=cancel)
     ctx = _ExecCtx(plan,
                    fuse=config.fuse if fused is None else bool(fused),
                    prefetch=config.prefetch if prefetch is None
-                   else int(prefetch))
+                   else int(prefetch),
+                   recovery=recovery)
     # one QueryMetrics per top-level execute (nested/re-entrant executes
     # attribute into the enclosing query); SRJT_METRICS=0 skips entirely
     with metrics.maybe_query(f"execute:{node_label(plan)}") as qm:
@@ -906,7 +1026,18 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
             cq = qm if qm is not None else metrics.current()
             if cq is not None and not cq.fingerprint:
                 cq.fingerprint = plan.fingerprint()
-        out = _exec(plan, {}, stats, ctx)
+        try:
+            out = _exec(plan, {}, stats, ctx)
+        except BaseException as e:
+            kind, _ = classify(e)
+            metrics.count(f"engine.errors.{kind}")
+            oq = qm if qm is not None else metrics.current()
+            if oq is not None:
+                oq.set_outcome("error", kind=kind, error=str(e))
+            raise
+        oq = qm if qm is not None else metrics.current()
+        if oq is not None:
+            oq.set_outcome("ok")
         if qm is not None:
             qm.note_stats(stats)
             # query-boundary device-memory sample: with the chunk-boundary

@@ -254,6 +254,16 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
         if cache_counters:
             foot.append("-- counters (this query): " + " ".join(
                 f"{k}={v}" for k, v in sorted(cache_counters.items())))
+        outcome = summary.get("outcome")
+        degr = summary.get("degradations")
+        if outcome or degr:
+            line = "-- outcome: " + (outcome or {}).get("status", "ok")
+            if (outcome or {}).get("kind"):
+                line += f" kind={outcome['kind']}"
+            if degr:
+                line += " degraded=" + ",".join(
+                    d.get("step", "?") for d in degr)
+            foot.append(line)
         text = text + "\n" + "\n".join(foot)
     return ExplainReport(text=text, nodes=nodes, summary=summary,
                          result=out)

@@ -1,0 +1,99 @@
+"""Query-level recovery policy: retry, degradation ladder, cancellation.
+
+The executor threads one :class:`RecoveryPolicy` through every streaming
+loop (``_ExecCtx``).  It owns three behaviors, each bounded and each loud:
+
+1. **Retry** — transient failures (kind ``transient`` in utils/errors.py)
+   retry per chunk with exponential backoff + deterministic jitter, at most
+   ``SRJT_RETRY_MAX`` times per site.  Counted as ``engine.retries`` /
+   ``engine.retries.<site>``.
+
+2. **Degradation ladder** — resource exhaustion (device
+   ``RESOURCE_EXHAUSTED``) is never blind-retried; the executor steps down
+   a ladder instead, each rung logged and counted as ``engine.degraded`` /
+   ``engine.degraded.<step>`` and recorded on the query's outcome:
+
+   - exchange: full capacity → **halved chunk capacity** → **spilled
+     shuffle** (``parallel/spill.py``) → **passthrough** (exchange elided —
+     content-equivalent because ``_hash_exchange`` returns the full
+     concatenated table either way, only placement is lost);
+   - fused streaming aggregate: compiled chunk programs → **interpreted
+     per-chunk path** (the Flare-style always-correct fallback).
+
+3. **Cancellation** — a :class:`CancelToken` (``SRJT_QUERY_TIMEOUT_S`` or
+   the bridge CANCEL opcode) checked at chunk boundaries and polled in the
+   prefetch producer; raises ``QueryCancelledError``/``QueryTimeoutError``
+   and unwinds through the existing ``close()`` machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..utils import metrics
+from ..utils.config import config, logger
+from ..utils.errors import (CancelToken, QueryCancelledError,
+                            QueryTimeoutError, classify,
+                            is_resource_exhausted, retry_call)
+
+__all__ = ["RecoveryPolicy", "CancelToken", "QueryCancelledError",
+           "QueryTimeoutError"]
+
+
+class RecoveryPolicy:
+    """Per-query retry/degradation policy + cancellation token carrier."""
+
+    __slots__ = ("retry_max", "backoff_s", "cancel", "degradations")
+
+    def __init__(self, cancel: Optional[CancelToken] = None,
+                 retry_max: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
+        self.retry_max = (config.retry_max if retry_max is None
+                          else int(retry_max))
+        self.backoff_s = (config.retry_backoff_s if backoff_s is None
+                          else float(backoff_s))
+        self.cancel = cancel
+        self.degradations: list[dict] = []
+
+    # -- retry ---------------------------------------------------------------
+
+    def retry(self, site: str, fn: Callable):
+        """Run ``fn``, retrying transient failures (bounded, backed off)."""
+        return retry_call(fn, site, retry_max=self.retry_max,
+                          backoff_s=self.backoff_s, cancel=self.cancel)
+
+    # -- cancellation --------------------------------------------------------
+
+    def checkpoint(self) -> None:
+        """Chunk-boundary cancellation/deadline check (no-op untokened)."""
+        if self.cancel is not None:
+            self.cancel.check()
+
+    # -- degradation ---------------------------------------------------------
+
+    def can_degrade(self, exc: BaseException) -> bool:
+        """Only resource exhaustion walks the ladder; transient failures
+        are the retry layer's job and cancellation/fatal propagate."""
+        return is_resource_exhausted(exc)
+
+    def degrade(self, step: str, exc: BaseException,
+                stats: Optional[dict] = None) -> None:
+        """Record one ladder step: count, log, stamp query outcome."""
+        kind, _ = classify(exc)
+        metrics.count("engine.degraded")
+        metrics.count(f"engine.degraded.{step}")
+        rec = {"step": step, "cause": kind, "error": str(exc)[:200]}
+        self.degradations.append(rec)
+        if stats is not None:
+            stats.setdefault("degradations", []).append(rec)
+        qm = metrics.current()
+        if qm is not None:
+            qm.degrade(step, kind)
+        logger().warning("degraded (%s) after %s: %s", step, kind, exc)
+
+
+def query_cancel_token() -> Optional[CancelToken]:
+    """A deadline token when ``SRJT_QUERY_TIMEOUT_S`` is set, else None."""
+    if config.query_timeout_s > 0:
+        return CancelToken(config.query_timeout_s)
+    return None

@@ -33,7 +33,8 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..columnar import Column, Table
-from ..utils import metrics, timeline
+from ..utils import faults, metrics, timeline
+from ..utils.errors import retry_call
 from . import snappy
 from .thrift import decode_struct
 
@@ -1077,12 +1078,17 @@ class ParquetChunkedReader:
     """
 
     def __init__(self, path, pass_read_limit: int = 64 << 20, columns=None,
-                 predicate: tuple | None = None, prefetch: int = 0):
+                 predicate: tuple | None = None, prefetch: int = 0,
+                 cancel=None):
         self.file = ParquetFile(path)
         self.limit = int(pass_read_limit)
         self.columns = columns
         self.predicate = predicate
         self.prefetch = int(prefetch)
+        # cooperative cancellation (utils.errors.CancelToken, duck-typed):
+        # checked per row group and polled by the prefetch producer so a
+        # cancelled/expired query releases its reader thread promptly
+        self.cancel = cancel
         # pruning observability: the engine's executor reports these through
         # its execution stats to prove predicate pushdown engaged
         self.groups_pruned = 0
@@ -1136,14 +1142,24 @@ class ParquetChunkedReader:
                 # mark at the batch boundary
                 scope.checkpoint()
 
+    def _decode_group_checked(self, gi: int):
+        faults.check("parquet.chunk")
+        return self.file._decode_group(gi, self.columns)
+
     def _host_slices(self):
         """Budget-bounded host-side chunk slices, pre device transfer."""
         for gi in range(self.file.num_row_groups):
+            if self.cancel is not None:
+                self.cancel.check()
             if self._group_pruned(gi):
                 self.groups_pruned += 1
                 continue
             self.groups_read += 1
-            hosts = self.file._decode_group(gi, self.columns)
+            # transient decode failures (flaky storage) retry per row
+            # group, bounded by SRJT_RETRY_MAX with backoff
+            hosts = retry_call(
+                lambda gi=gi: self._decode_group_checked(gi),
+                "parquet.chunk", cancel=self.cancel)
             nrows = hosts[0].num_rows
             if nrows == 0:
                 continue
@@ -1198,13 +1214,14 @@ class ParquetChunkedReader:
         if depth <= 0:
             yield from gen
         else:
-            yield from self._tracked(_prefetched(gen, depth))
+            yield from self._tracked(_prefetched(gen, depth, self.cancel))
 
     def __iter__(self):
         if self.prefetch <= 0:
             yield from self._chunks()
             return
-        yield from self._tracked(_prefetched(self._chunks(), self.prefetch))
+        yield from self._tracked(_prefetched(self._chunks(), self.prefetch,
+                                             self.cancel))
 
     def _tracked(self, pf):
         """Register a prefetch generator for ``close()`` while it runs."""
@@ -1218,7 +1235,10 @@ class ParquetChunkedReader:
                 pass  # close() already reaped it
 
 
-def _prefetched(gen, depth: int):
+_reap_warned = False
+
+
+def _prefetched(gen, depth: int, cancel=None):
     """Pipeline overlap (the per-thread-stream analog, SURVEY §2.3 "PP"):
     a worker thread produces item i+1..i+depth while the caller consumes
     item i.  jax dispatch is already async on the consumer side; this
@@ -1246,6 +1266,8 @@ def _prefetched(gen, depth: int):
     def put(item) -> bool:  # False once the consumer abandoned us
         t0 = time.perf_counter() if timed else 0.0
         while not stop.is_set():
+            if cancel is not None and cancel.should_stop():
+                return False  # stuck query: release the reader thread
             try:
                 q.put(item, timeout=0.1)
             except queue.Full:
@@ -1259,6 +1281,17 @@ def _prefetched(gen, depth: int):
             return True
         return False
 
+    def put_ctrl(item) -> None:
+        # DONE/FAIL sentinels must always land (the consumer blocks on
+        # q.get until one arrives) — only consumer abandonment (stop)
+        # releases this loop, never cancellation
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
     def producer():
         with metrics.bind(qm):
             try:
@@ -1271,6 +1304,7 @@ def _prefetched(gen, depth: int):
                         # arrow binds to the producer slice
                         with timeline.span("io.parquet.produce_chunk",
                                            {"chunk": n}):
+                            faults.check("parquet.prefetch")
                             try:
                                 item = next(it)
                             except StopIteration:
@@ -1278,15 +1312,25 @@ def _prefetched(gen, depth: int):
                             timeline.flow_start("io.parquet.chunk",
                                                 fid_base + n)
                         if not put(item):
+                            if not stop.is_set() and cancel is not None:
+                                cancel.check()  # -> typed error via FAIL
                             return
                         n += 1
                 else:
-                    for item in gen:
+                    it = iter(gen)
+                    while True:
+                        faults.check("parquet.prefetch")
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            break
                         if not put(item):
+                            if not stop.is_set() and cancel is not None:
+                                cancel.check()  # -> typed error via FAIL
                             return
-                put(DONE)
+                put_ctrl(DONE)
             except BaseException as e:  # surface decode errors to consumer
-                put((FAIL, e))
+                put_ctrl((FAIL, e))
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
@@ -1323,3 +1367,14 @@ def _prefetched(gen, depth: int):
             except queue.Empty:
                 break
         t.join(timeout=5)
+        if t.is_alive():
+            # the producer outlived the reap window: count it (the chaos
+            # soak asserts zero) and warn once rather than silently leak
+            metrics.count("io.prefetch.reap_timeouts")
+            global _reap_warned
+            if not _reap_warned:
+                _reap_warned = True
+                from ..utils.config import logger
+                logger().warning(
+                    "prefetch producer thread failed to stop within 5s "
+                    "(leaked; counted as io.prefetch.reap_timeouts)")

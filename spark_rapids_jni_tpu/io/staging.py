@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..columnar import Column, Table
+from ..utils import faults
 
 
 def _pad4(b: bytes) -> bytes:
@@ -165,6 +166,7 @@ def stage_fixed_table(specs, padded: bool = False):
     validity.  This is the chunk-pipeline form — every same-schema chunk
     shares ONE shape class, so fused plan segments (engine/segment.py)
     compile once and mask rows ``>= n_rows`` instead of slicing."""
+    faults.check("staging.transfer")
     blob = bytearray()
     plan = []
     posts = []  # (name, dtype, has_valid, n)

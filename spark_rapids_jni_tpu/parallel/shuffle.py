@@ -47,7 +47,7 @@ from ..ops.row_conversion import (RowLayout, _build_planes,
                                   _from_planes)
 from .mesh import ROW_AXIS, axis_size
 from .stringplane import explode_strings, reassemble_strings
-from ..utils import metrics, timeline
+from ..utils import faults, metrics, timeline
 from ..utils.tracing import traced
 
 
@@ -446,6 +446,7 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
     inflight: deque = deque()
     for item in chunks:
         tbl, live = item if isinstance(item, tuple) else (item, None)
+        faults.check("exchange.dispatch")
         out = shuffle_table_padded(tbl, mesh, list(keys), capacity=capacity,
                                    axis=axis, donate=donate, live=live,
                                    key_specs=key_specs)

@@ -46,7 +46,8 @@ from ..ops.row_conversion import fixed_width_layout, _from_planes
 from .mesh import ROW_AXIS, axis_size
 from .shuffle import (cap_bucket, key_specs_for, make_shuffle,
                       partition_counts, _spec_columns, partition_ids_specs)
-from ..utils import metrics, timeline
+from ..utils import faults, metrics, timeline
+from ..utils.errors import retry_call
 from ..utils.tracing import traced
 
 
@@ -93,6 +94,55 @@ def _unlink_quiet(path):
         pass
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True
+
+
+def sweep_orphans(spill_dir: str) -> int:
+    """Unlink spill files left by dead processes; returns the count.
+
+    The happy path reclaims via ``weakref.finalize`` on the memmap, but a
+    crashed query never runs its finalizers — its ``spill-<pid>-...npy``
+    files survive in ``spill_dir`` forever.  Names carry the owning pid,
+    so liveness is one ``kill(pid, 0)`` probe; our own files and those of
+    live processes are never touched.
+    """
+    try:
+        names = os.listdir(spill_dir)
+    except OSError:
+        return 0
+    me = os.getpid()
+    reaped = 0
+    for name in names:
+        if not (name.startswith("spill-") and name.endswith(".npy")):
+            continue
+        parts = name.split("-")
+        try:
+            pid = int(parts[1])
+        except (IndexError, ValueError):
+            continue
+        if pid == me or _pid_alive(pid):
+            continue
+        path = os.path.join(spill_dir, name)
+        try:
+            os.unlink(path)
+            reaped += 1
+        except OSError:
+            continue
+    if reaped:
+        metrics.count("parallel.spill.orphans_reaped", reaped)
+        from ..utils.config import logger
+        logger().warning("reaped %d orphaned spill file(s) in %s",
+                         reaped, spill_dir)
+    return reaped
+
+
 def _spill_buffers(schema, total_rows, spill_dir):
     """Per-column output buffers: RAM numpy, or memmaps under spill_dir."""
     from ..dtypes import TypeId
@@ -123,7 +173,8 @@ def _spill_buffers(schema, total_rows, spill_dir):
 def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
                           hbm_budget_bytes: int,
                           spill_dir: str | None = None,
-                          axis: str = ROW_AXIS):
+                          axis: str = ROW_AXIS,
+                          key_specs: tuple | None = None):
     """Shuffle by key hash with the device working set bounded by
     ``hbm_budget_bytes``; returns a HOST-resident compacted Table (numpy
     buffers, or memmaps under ``spill_dir``, unlinked automatically when
@@ -141,6 +192,8 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
             "spilled shuffle is fixed-width only; dictionary-encode "
             "(ops/dictionary) or explode (parallel/stringplane) first")
     from .mesh import pad_to_multiple, shard_table
+    if spill_dir is not None:
+        sweep_orphans(spill_dir)
     ndev = axis_size(mesh, axis)
     n_valid = table.num_rows
     if table.num_rows % ndev:
@@ -148,7 +201,8 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
         table, n_valid = pad_to_multiple(table, ndev)
     st = shard_table(table, mesh, axis)
     layout = fixed_width_layout(st.dtypes())
-    key_specs = key_specs_for(st, keys, None)
+    if key_specs is None:
+        key_specs = key_specs_for(st, keys, None)
 
     counts = partition_counts(st, mesh, list(keys), axis,
                               n_valid_rows=n_valid, key_specs=key_specs)
@@ -180,26 +234,34 @@ def shuffle_table_spilled(table: Table, mesh: Mesh, keys: list,
     metrics.observe("parallel.spill.pass_capacity_rows", cap_slice)
     fn = make_shuffle(mesh, layout, key_specs, cap_slice, axis)
     written = 0
+
+    def run_pass(p, window):
+        # writes land at offsets fixed by the pre-pass ``written``, so a
+        # transient failure replays the whole pass idempotently
+        faults.check("spill.write")
+        planes_in, ok, ovf = fn(datas, masks, window)
+        if int(ovf):
+            raise RuntimeError(
+                f"spill pass {p} overflow ({int(ovf)} rows)"
+                " — counts pass disagrees with payload")
+        d_in, m_in = _from_planes(layout, list(planes_in))
+        okn = np.asarray(ok)
+        keep = np.flatnonzero(okn)
+        nlive = keep.shape[0]
+        for ci, (d, m) in enumerate(zip(d_in, m_in)):
+            dn = np.asarray(d)
+            out_datas[ci][written:written + nlive] = dn[keep] if \
+                dn.ndim == 1 else dn[keep].reshape(nlive, *dn.shape[1:])
+            out_valids[ci][written:written + nlive] = \
+                np.asarray(m)[keep]
+        return nlive
+
     for p in range(npasses):
         lo, hi = p * cap_slice, (p + 1) * cap_slice
         window = (rank >= lo) & (rank < hi) & live
         with timeline.span("parallel.spill.pass",
                            {"pass": p, "capacity": int(cap_slice)}):
-            planes_in, ok, ovf = fn(datas, masks, window)
-            if int(ovf):
-                raise RuntimeError(
-                    f"spill pass {p} overflow ({int(ovf)} rows)"
-                    " — counts pass disagrees with payload")
-            d_in, m_in = _from_planes(layout, list(planes_in))
-            okn = np.asarray(ok)
-            keep = np.flatnonzero(okn)
-            nlive = keep.shape[0]
-            for ci, (d, m) in enumerate(zip(d_in, m_in)):
-                dn = np.asarray(d)
-                out_datas[ci][written:written + nlive] = dn[keep] if \
-                    dn.ndim == 1 else dn[keep].reshape(nlive, *dn.shape[1:])
-                out_valids[ci][written:written + nlive] = \
-                    np.asarray(m)[keep]
+            nlive = retry_call(lambda: run_pass(p, window), "spill.write")
             written += nlive
             metrics.count("parallel.spill.bytes_spilled",
                           nlive * (row_bytes + len(out_valids)))

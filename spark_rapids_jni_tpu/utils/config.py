@@ -27,6 +27,11 @@ environment flags read once at import:
 | ``SRJT_BROADCAST_ROWS`` | ``100000`` | broadcast-join threshold: estimated build rows at or under this replicate instead of shuffling |
 | ``SRJT_PROFILE_DIR``  | *(unset)* | persist one compact query profile JSON per query into this dir (utils/profile.py; empty = off) |
 | ``SRJT_PROFILE_CAP``  | ``512`` | on-disk profile ring capacity (oldest profiles pruned past this) |
+| ``SRJT_FAULTS``       | *(unset)* | deterministic fault injection spec ``site:nth[:kind],...`` (utils/faults.py; empty = all seams no-op) |
+| ``SRJT_RETRY_MAX``    | ``3``   | max per-site retries of transient failures (engine/recovery.py) |
+| ``SRJT_RETRY_BACKOFF_S`` | ``0.01`` | base retry backoff seconds (doubles per attempt, ±25% jitter) |
+| ``SRJT_QUERY_TIMEOUT_S`` | ``0`` | cooperative per-query deadline in seconds (0 = none; checked at chunk boundaries) |
+| ``SRJT_BRIDGE_TIMEOUT_S`` | ``60`` | per-op socket deadline on bridge client+server (0 = block forever, the pre-hardening behavior) |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -58,6 +63,16 @@ def _int_flag(name: str, default: int, minimum: int = 0) -> int:
         return default
 
 
+def _float_flag(name: str, default: float, minimum: float = 0.0) -> float:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return max(minimum, float(v.strip()))
+    except ValueError:
+        return default
+
+
 @dataclass
 class Config:
     trace: bool = False          # profiler annotations around ops
@@ -80,6 +95,11 @@ class Config:
     broadcast_rows: int = 100_000  # broadcast-join build-size threshold (rows)
     profile_dir: str = ""        # query-profile store dir (empty = off)
     profile_cap: int = 512       # profile-store ring capacity (files)
+    faults: str = ""             # fault-injection spec (utils/faults.py)
+    retry_max: int = 3           # transient-failure retry bound per site
+    retry_backoff_s: float = 0.01  # base retry backoff (doubles/attempt)
+    query_timeout_s: float = 0.0   # cooperative query deadline (0 = none)
+    bridge_timeout_s: float = 60.0  # bridge per-op socket deadline (0=off)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -105,6 +125,11 @@ class Config:
             broadcast_rows=_int_flag("SRJT_BROADCAST_ROWS", 100_000),
             profile_dir=os.environ.get("SRJT_PROFILE_DIR", "").strip(),
             profile_cap=_int_flag("SRJT_PROFILE_CAP", 512, minimum=1),
+            faults=os.environ.get("SRJT_FAULTS", "").strip(),
+            retry_max=_int_flag("SRJT_RETRY_MAX", 3),
+            retry_backoff_s=_float_flag("SRJT_RETRY_BACKOFF_S", 0.01),
+            query_timeout_s=_float_flag("SRJT_QUERY_TIMEOUT_S", 0.0),
+            bridge_timeout_s=_float_flag("SRJT_BRIDGE_TIMEOUT_S", 60.0),
         )
 
 

@@ -158,7 +158,7 @@ class QueryMetrics:
 
     __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
                  "node_spans", "hists", "timers", "mem", "fingerprint",
-                 "_lock")
+                 "outcome", "degradations", "_lock")
 
     def __init__(self, name: str = ""):
         self.qid = next(_qids)
@@ -172,6 +172,8 @@ class QueryMetrics:
         self.timers: dict[str, float] = {}
         self.mem: dict = {}  # device-memory telemetry (mem_sample)
         self.fingerprint: str = ""  # plan fingerprint (profile-store key)
+        self.outcome: dict = {}  # status/kind/error (engine/recovery.py)
+        self.degradations: list = []  # ladder steps taken (step, cause)
         self._lock = threading.Lock()
 
     def count(self, name: str, n: int = 1) -> None:
@@ -247,6 +249,21 @@ class QueryMetrics:
     def note_stats(self, stats: dict) -> None:
         self.stats = dict(stats)
 
+    def degrade(self, step: str, cause: str = "") -> None:
+        """Record one degradation-ladder step (engine/recovery.py)."""
+        with self._lock:
+            self.degradations.append({"step": step, "cause": cause})
+
+    def set_outcome(self, status: str, kind: str = "",
+                    error: str = "") -> None:
+        """Stamp the query's terminal status (``ok`` | ``error``)."""
+        with self._lock:
+            self.outcome = {"status": status}
+            if kind:
+                self.outcome["kind"] = kind
+            if error:
+                self.outcome["error"] = error[:200]
+
     def finish(self) -> None:
         if self.wall_s is None:
             self.wall_s = time.perf_counter() - self.t0
@@ -271,6 +288,10 @@ class QueryMetrics:
                 out["fingerprint"] = self.fingerprint
             if self.mem:
                 out["memory"] = dict(self.mem)
+            if self.outcome:
+                out["outcome"] = dict(self.outcome)
+            if self.degradations:
+                out["degradations"] = list(self.degradations)
             return out
 
 

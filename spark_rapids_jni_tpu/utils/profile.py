@@ -45,8 +45,10 @@ VERSION = 1
 _HIST_FIELDS = ("count", "sum", "mean", "min", "max", "p50", "p90", "p99")
 
 #: counter prefixes worth keeping per profile — cache attribution, sync
-#: counts, exchange/shuffle traffic, bridge health
-_COUNTER_KEEP = ("engine.exchange", "parallel.shuffle", "bridge.")
+#: counts, exchange/shuffle traffic, bridge health, recovery activity
+_COUNTER_KEEP = ("engine.exchange", "parallel.shuffle", "bridge.",
+                 "engine.errors", "engine.retries", "engine.degraded",
+                 "faults.injected")
 
 
 def enabled() -> bool:
@@ -117,6 +119,12 @@ def compact(summary: dict) -> dict:
                            (summary.get("histograms") or {}).items()}}
     if summary.get("memory"):
         prof["memory"] = dict(summary["memory"])
+    # recovery attribution: how the query ended and what capacity it gave
+    # up on the way (srjt_profile diff flags degradation regressions)
+    if summary.get("outcome"):
+        prof["outcome"] = dict(summary["outcome"])
+    if summary.get("degradations"):
+        prof["degradations"] = [dict(d) for d in summary["degradations"]]
     return prof
 
 
@@ -269,6 +277,17 @@ def diff(base: dict | str, cand: dict | str) -> dict:
         hists[k] = {"p99_base": pa, "p99_cand": pb}
         if pa and pb and pb / pa > 1 + _SLOW_FRAC:
             flags.append(f"p99-up: {k} {pa:.6g} -> {pb:.6g}")
+    # degradation attribution: a candidate run that gave up capacity
+    # (interpreted fallback, halved/spilled/passthrough exchange) is a
+    # regression even when its wall time looks fine
+    base_steps = [d.get("step", "?") for d in a.get("degradations", ())]
+    cand_steps = [d.get("step", "?") for d in b.get("degradations", ())]
+    for step in cand_steps:
+        if step not in base_steps:
+            flags.append(f"degraded: {step}")
+    ob, oc = a.get("outcome") or {}, b.get("outcome") or {}
+    if oc.get("status") == "error" and ob.get("status") != "error":
+        flags.append(f"outcome-error: kind={oc.get('kind', '?')}")
     return {"fingerprint": a.get("fingerprint", ""),
             "fingerprint_match":
                 a.get("fingerprint", "") == b.get("fingerprint", ""),

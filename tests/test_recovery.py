@@ -1,0 +1,536 @@
+"""Fault injection + failure-domain recovery (docs/ROBUSTNESS.md).
+
+The contract under test, per injection site x kind:
+
+- transient faults retry in place and reach *parity* with the clean run,
+  with the retry counters telling the story;
+- resource faults walk the degradation ladder (interpreted fallback,
+  exchange halved/spilled/passthrough) and still reach parity;
+- exhausted retries, cancellation, and deadlines surface *typed* errors
+  (utils/errors.py taxonomy), never hangs;
+- with SRJT_FAULTS unset, the seams are inert.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.engine import Aggregate, Scan, execute
+from spark_rapids_jni_tpu.engine.plan import Exchange
+from spark_rapids_jni_tpu.utils import config as cfg
+from spark_rapids_jni_tpu.utils import errors, faults, metrics, tracing
+
+
+@pytest.fixture
+def warehouse(tmp_path):
+    n = 40_000
+    path = str(tmp_path / "fact.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array((np.arange(n) % 13).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    }), path, row_group_size=4096)
+    return path
+
+
+@pytest.fixture
+def arm(monkeypatch):
+    """Set SRJT_FAULTS (+ optional knobs), refresh config, re-arm counters;
+    teardown restores the clean config."""
+    def _arm(spec, **env):
+        monkeypatch.setenv("SRJT_FAULTS", spec)
+        for k, v in env.items():
+            monkeypatch.setenv(k, str(v))
+        cfg.refresh()
+        faults.reset()
+    yield _arm
+    # this finalizer runs BEFORE monkeypatch's env restore (LIFO), so
+    # scrub the vars explicitly before re-reading the config
+    monkeypatch.delenv("SRJT_FAULTS", raising=False)
+    for k in ("SRJT_RETRY_BACKOFF_S", "SRJT_QUERY_TIMEOUT_S",
+              "SRJT_RETRY_MAX"):
+        monkeypatch.delenv(k, raising=False)
+    cfg.refresh()
+    faults.reset()
+
+
+def _agg_plan(path, chunk_bytes=1 << 16):
+    return Aggregate(Scan(path, chunk_bytes=chunk_bytes),
+                     ["k"], [("v", "sum")], names=["s"])
+
+
+def _sorted_cols(t):
+    order = np.argsort(np.asarray(t.column("k").data), kind="stable")
+    return [np.asarray(c.data)[order] for c in t.columns]
+
+
+def _assert_parity(a, b):
+    assert a.num_rows == b.num_rows
+    for x, y in zip(_sorted_cols(a), _sorted_cols(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+# -- spec grammar -------------------------------------------------------------
+
+def test_parse_spec_grammar():
+    rules = faults.parse("parquet.chunk:3:io_error,exchange.dispatch:1:oom")
+    assert rules == {"parquet.chunk": [(3, "io_error")],
+                     "exchange.dispatch": [(1, "oom")]}
+    # kind defaults to io_error; * means every occurrence
+    assert faults.parse("spill.write:2") == {"spill.write": [(2, "io_error")]}
+    assert faults.parse("bridge.op:*:timeout") == {
+        "bridge.op": [(None, "timeout")]}
+    # several rules on one site accumulate
+    assert faults.parse("parquet.chunk:1,parquet.chunk:4:oom") == {
+        "parquet.chunk": [(1, "io_error"), (4, "oom")]}
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuch.site:1", "parquet.chunk:0", "parquet.chunk:x",
+    "parquet.chunk:1:nosuchkind", "parquet.chunk", ":::",
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse(bad)
+
+
+def test_check_is_inert_when_unarmed(metrics_isolation):
+    metrics_isolation("faults.")
+    assert not cfg.config.faults
+    for site in faults.SITES:
+        faults.check(site)  # must be a no-op, not an error
+    assert not any(tracing.counters_snapshot("faults.").values())
+
+
+# -- taxonomy -----------------------------------------------------------------
+
+@pytest.mark.parametrize("exc,kind,retryable", [
+    (errors.TransientError("x"), "transient", True),
+    (errors.ResourceExhaustedError("x"), "resource", False),
+    (errors.QueryCancelledError("x"), "cancelled", False),
+    (errors.QueryTimeoutError("x"), "cancelled", False),
+    (errors.BridgeTimeoutError("x"), "transient", True),
+    (MemoryError("x"), "resource", False),
+    (RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating"),
+     "resource", False),
+    (TimeoutError("x"), "transient", True),
+    (ConnectionError("x"), "transient", True),
+    (OSError("x"), "transient", True),
+    (ValueError("x"), "fatal", False),
+])
+def test_classify(exc, kind, retryable):
+    assert errors.classify(exc) == (kind, retryable)
+
+
+def test_wire_round_trip_typed():
+    for make in (errors.TransientError, errors.ResourceExhaustedError,
+                 errors.QueryCancelledError, errors.QueryTimeoutError,
+                 errors.BridgeTimeoutError):
+        e = make("boom")
+        doc = json.loads(json.dumps(errors.to_wire(e)))
+        back = errors.from_wire(doc)
+        assert type(back) is type(e)
+        assert errors.classify(back) == errors.classify(e)
+        assert "boom" in str(back)
+
+
+def test_wire_fallbacks_keep_kind_and_text():
+    # unknown type, known kind -> kind-matched EngineError subclass
+    back = errors.from_wire({"error": "taxonomy", "kind": "resource",
+                             "type": "SomeXlaError", "msg": "no memory"})
+    assert errors.classify(back)[0] == "resource"
+    assert "SomeXlaError" in str(back) and "no memory" in str(back)
+    # fatal -> plain RuntimeError with the original text preserved
+    back = errors.from_wire({"error": "taxonomy", "kind": "fatal",
+                             "type": "TypeError", "msg": "bad handle"})
+    assert type(back) is RuntimeError and "bad handle" in str(back)
+
+
+# -- retry_call ---------------------------------------------------------------
+
+def test_retry_call_recovers_and_counts(metrics_isolation):
+    metrics_isolation("engine.retries")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise errors.TransientError("hiccup")
+        return "ok"
+
+    assert errors.retry_call(flaky, "unit.test",
+                             retry_max=3, backoff_s=0.0) == "ok"
+    snap = tracing.counters_snapshot("engine.retries")
+    assert snap.get("engine.retries") == 2
+    assert snap.get("engine.retries.unit.test") == 2
+
+
+def test_retry_call_exhaustion_raises_last_error():
+    with pytest.raises(errors.TransientError):
+        errors.retry_call(lambda: (_ for _ in ()).throw(
+            errors.TransientError("always")), "unit.test",
+            retry_max=2, backoff_s=0.0)
+
+
+def test_retry_backoff_is_stable_across_processes():
+    """Backoff jitter must not depend on PYTHONHASHSEED — the chaos soak
+    compares timings across processes, so two interpreters with different
+    hash seeds must compute identical delay schedules."""
+    import subprocess
+    import sys
+    code = (
+        "import json\n"
+        "from spark_rapids_jni_tpu.utils import errors\n"
+        "delays = []\n"
+        "errors.time.sleep = lambda s: delays.append(round(s, 9))\n"
+        "def boom():\n"
+        "    raise errors.TransientError('x')\n"
+        "try:\n"
+        "    errors.retry_call(boom, 'jitter.site', retry_max=3,\n"
+        "                      backoff_s=1.0)\n"
+        "except errors.TransientError:\n"
+        "    pass\n"
+        "print(json.dumps(delays))\n"
+    )
+    import spark_rapids_jni_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(spark_rapids_jni_tpu.__file__)))
+    outs = []
+    for seed in ("0", "1"):
+        env = dict(os.environ, PYTHONHASHSEED=seed,
+                   PYTHONPATH=pkg_root + os.pathsep +
+                   os.environ.get("PYTHONPATH", ""))
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, check=True)
+        outs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 3  # retry_max delays were actually scheduled
+
+
+def test_retry_call_never_retries_resource():
+    calls = []
+
+    def oom():
+        calls.append(1)
+        raise errors.ResourceExhaustedError("full")
+
+    with pytest.raises(errors.ResourceExhaustedError):
+        errors.retry_call(oom, "unit.test", retry_max=5, backoff_s=0.0)
+    assert len(calls) == 1  # same footprint fails the same way: no retry
+
+
+# -- cancellation -------------------------------------------------------------
+
+def test_cancel_token_flip_and_deadline():
+    tok = errors.CancelToken()
+    assert not tok.should_stop()
+    tok.cancel("user said stop")
+    assert tok.should_stop()
+    with pytest.raises(errors.QueryCancelledError, match="user said stop"):
+        tok.check()
+
+    tok = errors.CancelToken(timeout_s=0.01)
+    time.sleep(0.03)
+    assert tok.should_stop()
+    with pytest.raises(errors.QueryTimeoutError):
+        tok.check()
+    assert errors.classify(errors.QueryTimeoutError("x"))[0] == "cancelled"
+
+
+def test_execute_honours_cancel_token(warehouse):
+    tok = errors.CancelToken()
+    tok.cancel("pre-cancelled")
+    with pytest.raises(errors.QueryCancelledError):
+        execute(_agg_plan(warehouse), cancel=tok)
+
+
+def test_query_timeout_env_is_a_typed_error(warehouse, arm):
+    # every chunk decode sleeps HANG_S; a microscopic budget expires at
+    # the first chunk boundary -> QueryTimeoutError, not a hang
+    arm("parquet.chunk:*:timeout", SRJT_QUERY_TIMEOUT_S="0.001")
+    with pytest.raises(errors.QueryCancelledError):
+        execute(_agg_plan(warehouse))
+
+
+# -- injected faults through the executor ------------------------------------
+
+def test_transient_chunk_fault_retries_to_parity(
+        warehouse, arm, metrics_isolation):
+    metrics_isolation("engine.retries")
+    metrics_isolation("faults.injected")
+    plan = _agg_plan(warehouse)
+    base = execute(plan)
+    arm("parquet.chunk:2:io_error", SRJT_RETRY_BACKOFF_S="0.001")
+    out = execute(plan)
+    _assert_parity(base, out)
+    snap = tracing.counters_snapshot("")
+    assert snap.get("engine.retries.parquet.chunk") == 1
+    assert snap.get("faults.injected.parquet.chunk.io_error") == 1
+
+
+def test_exhausted_retries_surface_typed(warehouse, arm):
+    arm("parquet.chunk:*:io_error", SRJT_RETRY_BACKOFF_S="0.001")
+    with pytest.raises(errors.TransientError):
+        execute(_agg_plan(warehouse))
+
+
+def test_staging_oom_degrades_to_interpreted(
+        warehouse, arm, metrics_isolation):
+    metrics_isolation("engine.degraded")
+    plan = _agg_plan(warehouse)
+    base_stats = {}
+    base = execute(plan, stats=base_stats)
+    arm("staging.transfer:1:oom")
+    stats = {}
+    out = execute(plan, stats=stats)
+    _assert_parity(base, out)
+    steps = [d["step"] for d in stats["degradations"]]
+    assert steps == ["stream-interpreted"]
+    assert tracing.counters_snapshot("engine.degraded").get(
+        "engine.degraded.stream-interpreted") == 1
+    # the failed fused attempt's partial evidence is dropped before the
+    # interpreted re-run: chunk/row-group accounting matches the clean run
+    # instead of double-counting the aborted pass
+    assert stats["chunks"] == base_stats["chunks"]
+    assert stats["row_groups_read"] == base_stats["row_groups_read"]
+    assert stats["row_groups_pruned"] == base_stats["row_groups_pruned"]
+    assert not stats.get("fused_segments")  # the re-run never fused
+
+
+def test_error_outcome_recorded(warehouse, arm, metrics_isolation):
+    metrics_isolation("engine.errors")
+    arm("parquet.chunk:*:oom")
+    with metrics.query("recovery-outcome") as qm:
+        with pytest.raises(errors.ResourceExhaustedError):
+            execute(_agg_plan(warehouse))
+    if qm is not None:  # SRJT_METRICS on (the default)
+        out = qm.summary()["outcome"]
+        assert out["status"] == "error" and out["kind"] == "resource"
+    assert tracing.counters_snapshot("engine.errors").get(
+        "engine.errors.resource") == 1
+
+
+# -- exchange degradation ladder (8-device mesh) ------------------------------
+
+def _exchange_plan(path):
+    return Aggregate(Exchange(Scan(path, chunk_bytes=1 << 16), ["k"]),
+                     ["k"], [("v", "sum")], names=["s"])
+
+
+def test_exchange_oom_walks_the_ladder(warehouse, arm, metrics_isolation):
+    metrics_isolation("engine.degraded")
+    plan = _exchange_plan(warehouse)
+    base = execute(plan)
+    # first dispatch OOMs once -> retry rung is skipped (resource is not
+    # retryable) -> halved-capacity rerun succeeds
+    arm("exchange.dispatch:1:oom")
+    stats = {}
+    out = execute(plan, stats=stats)
+    _assert_parity(base, out)
+    assert [d["step"] for d in stats["degradations"]] == ["exchange-halved"]
+    # every dispatch OOMs -> halved rung fails too -> spilled shuffle
+    arm("exchange.dispatch:*:oom")
+    stats = {}
+    out = execute(plan, stats=stats)
+    _assert_parity(base, out)
+    assert [d["step"] for d in stats["degradations"]] == [
+        "exchange-halved", "exchange-spilled"]
+    snap = tracing.counters_snapshot("engine.degraded")
+    assert snap.get("engine.degraded.exchange-halved") == 2
+    assert snap.get("engine.degraded.exchange-spilled") == 1
+
+
+def test_exchange_passthrough_last_rung(warehouse, arm):
+    plan = _exchange_plan(warehouse)
+    base = execute(plan)
+    # spilled rung is knocked out too -> passthrough keeps content parity
+    arm("exchange.dispatch:*:oom,spill.write:*:oom",
+        SRJT_RETRY_BACKOFF_S="0.001")
+    stats = {}
+    out = execute(plan, stats=stats)
+    _assert_parity(base, out)
+    assert [d["step"] for d in stats["degradations"]] == [
+        "exchange-halved", "exchange-spilled", "exchange-passthrough"]
+
+
+# -- spill hygiene ------------------------------------------------------------
+
+def test_spill_orphan_sweep(tmp_path, metrics_isolation):
+    from spark_rapids_jni_tpu.parallel.spill import sweep_orphans
+    metrics_isolation("parallel.spill.orphans_reaped")
+    sd = tmp_path / "spill"
+    sd.mkdir()
+    # a dead pid's file, our own file, and a non-spill bystander
+    dead = sd / "spill-999999999-0.npy"
+    ours = sd / f"spill-{os.getpid()}-0.npy"
+    other = sd / "notes.txt"
+    for f in (dead, ours, other):
+        f.write_bytes(b"x")
+    assert sweep_orphans(str(sd)) == 1
+    assert not dead.exists() and ours.exists() and other.exists()
+    assert tracing.counters_snapshot("parallel.spill").get(
+        "parallel.spill.orphans_reaped") == 1
+    # idempotent: nothing left to reap
+    assert sweep_orphans(str(sd)) == 0
+
+
+def test_prefetch_producers_never_leak(warehouse, arm, metrics_isolation):
+    metrics_isolation("io.prefetch")
+    plan = _agg_plan(warehouse)
+    arm("parquet.prefetch:2:io_error", SRJT_RETRY_BACKOFF_S="0.001")
+    with pytest.raises(errors.TransientError):
+        execute(plan)
+    time.sleep(0.1)
+    assert not tracing.counters_snapshot("io.prefetch").get(
+        "io.prefetch.reap_timeouts")
+
+
+# -- bridge hardening ---------------------------------------------------------
+
+def test_bridge_client_timeout_is_typed(tmp_path):
+    """A server that accepts but never replies must become a typed
+    BridgeTimeoutError at the socket deadline, not a forever-blocked
+    recv."""
+    sock_path = str(tmp_path / "wedged.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(1)
+    held = []
+    t = threading.Thread(
+        target=lambda: held.append(srv.accept()[0]), daemon=True)
+    t.start()
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    c = BridgeClient(sock_path, timeout=0.3)
+    try:
+        with pytest.raises(errors.BridgeTimeoutError):
+            c.ping()
+        assert errors.classify(errors.BridgeTimeoutError("x")) == \
+            ("transient", True)
+        # the timed-out connection is poisoned: the server's late reply
+        # must never be read as the NEXT op's reply, so the socket is
+        # closed and further calls refuse (non-retryable) until reconnect
+        assert c.sock is None
+        with pytest.raises(RuntimeError, match="unusable"):
+            c.ping()
+    finally:
+        c.close()
+        for s in held:
+            s.close()
+        srv.close()
+
+
+def test_bridge_client_midframe_timeout_is_typed(tmp_path):
+    """A server that sends PART of a reply frame then wedges must surface
+    the same typed BridgeTimeoutError as the idle case (and poison the
+    client), not a flat ConnectionError."""
+    sock_path = str(tmp_path / "halfframe.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(1)
+    held = []
+
+    def half_reply():
+        conn, _ = srv.accept()
+        held.append(conn)
+        conn.recv(1024)          # consume the ping request
+        conn.sendall(b"\x05\x00")  # 2 of the 4 header bytes, then stall
+
+    threading.Thread(target=half_reply, daemon=True).start()
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    c = BridgeClient(sock_path, timeout=0.3)
+    try:
+        with pytest.raises(errors.BridgeTimeoutError):
+            c.ping()
+        assert c.sock is None
+    finally:
+        c.close()
+        for s in held:
+            s.close()
+        srv.close()
+
+
+def test_plan_execute_exempt_from_op_deadline(tmp_path, warehouse, arm):
+    """PLAN_EXECUTE's runtime is unbounded by design: a query that runs
+    longer than SRJT_BRIDGE_TIMEOUT_S must still complete, not die on the
+    per-op socket deadline (SRJT_QUERY_TIMEOUT_S/OP_CANCEL bound it)."""
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    from spark_rapids_jni_tpu.bridge.server import BridgeServer
+    # slow every chunk decode so the plan reliably outlives the 0.2 s
+    # client deadline (10 row groups x HANG_S >> 0.2 s)
+    arm("parquet.chunk:*:timeout")
+    sock = str(tmp_path / "slowplan.sock")
+    server = BridgeServer(sock)
+    st = threading.Thread(target=server.serve_forever, daemon=True)
+    st.start()
+    for _ in range(100):
+        if os.path.exists(sock):
+            break
+        time.sleep(0.01)
+    c = BridgeClient(sock, timeout=0.2)
+    try:
+        handles = c.execute_plan(_agg_plan(warehouse))
+        assert len(handles) == 1
+        nrows, _schema = c.table_meta(handles[0])
+        assert nrows == 13  # one group per key value
+    finally:
+        c.shutdown_server()
+        st.join(timeout=10)
+
+
+def test_bridge_taxonomy_reconstruction():
+    from spark_rapids_jni_tpu.bridge.client import _bridge_error
+    from spark_rapids_jni_tpu.bridge.server import _error_body
+    e = _bridge_error(_error_body(errors.ResourceExhaustedError("no HBM")))
+    assert type(e) is errors.ResourceExhaustedError and "no HBM" in str(e)
+    e = _bridge_error(_error_body(TypeError("handle 7 is not a table")))
+    assert isinstance(e, RuntimeError) and "handle 7" in str(e)
+    assert errors.classify(e) == ("fatal", False)
+
+
+def test_bridge_cancel_interrupts_plan_execute(tmp_path, warehouse, arm):
+    """OP_CANCEL from a second connection flips the in-flight
+    PLAN_EXECUTE's token; the submitting client gets a typed cancelled
+    error back through the taxonomy reply."""
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    from spark_rapids_jni_tpu.bridge.server import BridgeServer
+    # slow every chunk decode so the plan is reliably still running when
+    # the cancel lands (10 row groups x HANG_S >> 0.1 s)
+    arm("parquet.chunk:*:timeout", SRJT_RETRY_BACKOFF_S="0.001")
+    sock = str(tmp_path / "cancel.sock")
+    server = BridgeServer(sock)
+    st = threading.Thread(target=server.serve_forever, daemon=True)
+    st.start()
+    for _ in range(100):  # wait for the socket to exist
+        if os.path.exists(sock):
+            break
+        time.sleep(0.01)
+    c1 = BridgeClient(sock)
+    result: list = []
+
+    def submit():
+        try:
+            result.append(("ok", c1.execute_plan(_agg_plan(warehouse))))
+        except Exception as e:  # noqa: BLE001 — the test classifies
+            result.append(("err", e))
+
+    worker = threading.Thread(target=submit, daemon=True)
+    worker.start()
+    time.sleep(0.2)  # plan is mid-stream now
+    c2 = BridgeClient(sock)
+    try:
+        n = c2.cancel()
+        assert n == 1
+        worker.join(timeout=30)
+        assert result and result[0][0] == "err"
+        err = result[0][1]
+        assert errors.classify(err)[0] == "cancelled", err
+    finally:
+        c2.shutdown_server()
+        c1.close()
+        st.join(timeout=10)

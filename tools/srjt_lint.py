@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Repo lint for the engine's static invariants (docs/ANALYSIS.md pass 3).
 
-Three stdlib-``ast`` rules over ``spark_rapids_jni_tpu/``:
+Four stdlib-``ast`` rules over ``spark_rapids_jni_tpu/``:
 
 - **traced-host-op** — no ``.item()`` / ``float()`` / ``bool()`` / ``int()``
   / ``np.asarray`` / ``.tolist()`` / ``jax.device_get`` /
@@ -17,6 +17,10 @@ Three stdlib-``ast`` rules over ``spark_rapids_jni_tpu/``:
   carry a ``label=`` that is a literal member of ``verify.SYNC_WHITELIST``:
   adding a fourth deliberate sync means adding it to the whitelist, in
   one reviewable diff.
+- **bare-except** — no bare ``except:`` under ``bridge/`` / ``engine/`` /
+  ``parallel/``: the recovery layer (engine/recovery.py) dispatches on the
+  ``utils/errors`` taxonomy, and a bare catch swallows cancellation and
+  resource exhaustion indistinguishably.
 
 Plus two import-time passes:
 
@@ -56,6 +60,10 @@ TRACED_FUNCS = {
 }
 
 #: attribute calls that concretize a tracer / pull data to host
+#: subtrees where a bare `except:` is a lint violation — the failure-domain
+#: hardening (engine/recovery.py) depends on every catch being classifiable
+_NO_BARE_EXCEPT = (f"{PKG}/bridge/", f"{PKG}/engine/", f"{PKG}/parallel/")
+
 _HOST_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
 #: builtin casts that concretize when applied to a traced array
 _HOST_NAME_CALLS = {"float", "int", "bool"}
@@ -140,6 +148,17 @@ class _FileLint(ast.NodeVisitor):
             self.out.append(_violation(
                 "config-env-read", self.relpath, node.lineno,
                 f"os.{node.attr} outside utils/config.py"))
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        # failure-domain code must classify what it catches (utils/errors
+        # taxonomy): a bare `except:` swallows cancellation and OOM alike,
+        # so none are allowed in the recovery-bearing subtrees
+        if node.type is None and self.relpath.startswith(_NO_BARE_EXCEPT):
+            self.out.append(_violation(
+                "bare-except", self.relpath, node.lineno,
+                "bare `except:` in failure-domain code (catch a type; "
+                "see utils/errors taxonomy)"))
         self.generic_visit(node)
 
 
