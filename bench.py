@@ -613,7 +613,9 @@ def bench_engine_q5(n=200_000):
             same = got.num_rows == want.num_rows and all(
                 np.allclose(np.asarray(a.data), np.asarray(b.data))
                 for a, b in zip(got.columns, want.columns))
-            cache = c.metrics()["plan_cache"]
+            # prefix narrows the counter/hist/gauge blocks server-side;
+            # the plan_cache block rides along regardless
+            cache = c.metrics(prefix="bridge.")["plan_cache"]
             c.shutdown_server()
         except Exception as e:
             print(f"engine bench failed: {e!r}", file=sys.stderr)
@@ -1046,6 +1048,24 @@ if _m.enabled():
     from spark_rapids_jni_tpu.engine.explain import explain_analyze
     rep = explain_analyze(mkplan())
     dev_attrib["explain_skew_rendered"] = "skew=" in rep.text
+    # the AQE evidence plane on the same report: every plan-node line must
+    # carry the cardinality columns, and the decision footer's structural
+    # entry count must equal the static census of the optimized plan
+    from spark_rapids_jni_tpu.engine.verify import decision_census
+    node_lines = [ln for ln in rep.text.splitlines()
+                  if ln.strip() and not ln.lstrip().startswith("--")]
+    cen = decision_census(optimize(mkplan(), distribute=True), dist=True)
+    pathed = sum(1 for d in rep.decisions if "path" in d)
+    dev_attrib["evidence"] = {{
+        "node_lines_annotated": all("est_rows=" in ln and "q_error=" in ln
+                                    for ln in node_lines),
+        "decisions": len(rep.decisions),
+        "decisions_pathed": pathed,
+        "census": len(cen),
+        "census_matches": pathed == len(cen),
+        "footer_rendered":
+            ("-- decisions (" + str(len(rep.decisions)) + "):") in rep.text,
+    }}
     del os.environ["SRJT_DIST"]
 
 del os.environ["SRJT_BROADCAST_ROWS"]
@@ -1231,7 +1251,14 @@ def smoke():
                # per-device attribution invariants (False fails; None =
                # metrics off, nothing to check)
                and dattr.get("matrix_matches") is not False
-               and dattr.get("explain_skew_rendered") is not False)
+               and dattr.get("explain_skew_rendered") is not False
+               # AQE evidence plane: cardinality columns on every node
+               # line, decision footer count == static census (absent =
+               # metrics off, nothing to check)
+               and (dattr.get("evidence") or {}).get(
+                   "node_lines_annotated") is not False
+               and (dattr.get("evidence") or {}).get(
+                   "census_matches") is not False)
     print(json.dumps({"metric": "engine_dist_smoke",
                       "ok": dok,
                       "exchanges": dres["exchanges"] if dres else None,
@@ -1261,7 +1288,58 @@ def smoke():
                       "ok": pok,
                       "enabled": profile.enabled(),
                       **psumm}))
-    return 0 if (ok and jok and mok and tok and dok and pok) else 1
+    # seventh line: the observability layer's own price — the same tiny
+    # aggregate timed under SRJT_METRICS=0 and =1.  The on/off ratio is
+    # gated report-only (machine noise dwarfs the per-chunk dict writes
+    # at smoke scale); the line exists so a pathological regression in
+    # the metrics hot path shows up in the bench artifact immediately.
+    import tempfile
+    import time as _time
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_jni_tpu.engine import (Aggregate, Scan, execute,
+                                             new_stats, optimize)
+    from spark_rapids_jni_tpu.utils.config import refresh as _refresh
+    ov_dir = tempfile.mkdtemp(prefix="srjt-ov-")
+    ov_path = os.path.join(ov_dir, "ov.parquet")
+    rng = np.random.default_rng(3)
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 50, 20_000).astype(np.int64)),
+        "v": pa.array(rng.uniform(0.0, 1.0, 20_000)),
+    }), ov_path, row_group_size=2_000)
+    ov_plan = Aggregate(Scan(ov_path, chunk_bytes=32_000), ["k"],
+                        [("v", "sum")], names=["s"])
+    ov_opt = optimize(ov_plan)
+    prev_flag = os.environ.get("SRJT_METRICS")
+    ov_ms = {}
+    try:
+        for flag in ("0", "1"):
+            os.environ["SRJT_METRICS"] = flag
+            _refresh()
+            execute(ov_opt, new_stats())  # warm (compile)
+            t0 = _time.perf_counter()
+            for _ in range(3):
+                with metrics.query("overhead"):
+                    execute(ov_opt, new_stats())
+            ov_ms[flag] = (_time.perf_counter() - t0) * 1e3 / 3
+    finally:
+        if prev_flag is None:
+            os.environ.pop("SRJT_METRICS", None)
+        else:
+            os.environ["SRJT_METRICS"] = prev_flag
+        _refresh()
+    ov_ratio = (ov_ms["1"] / ov_ms["0"]) if ov_ms.get("0") else None
+    vok = bool(ov_ratio and ov_ratio > 0)
+    print(json.dumps({"metric": "metrics_overhead",
+                      "ok": vok,
+                      "latency_ms": {
+                          "metrics_off": round(ov_ms.get("0", 0.0), 3),
+                          "metrics_on": round(ov_ms.get("1", 0.0), 3),
+                      },
+                      "ratios": {"on_vs_off": round(ov_ratio, 4)
+                                 if ov_ratio else None}}))
+    return 0 if (ok and jok and mok and tok and dok and pok and vok) else 1
 
 
 def main():

@@ -94,6 +94,59 @@ assert prof[0]["top_exchange_skew"] is not None, \
     "no exchange skew reached the profile store"
 print("profile store: %d profiles at %s, top skew %s" %
       (prof[0]["profiles"], prof[0]["dir"], prof[0]["top_exchange_skew"]))
+# AQE evidence plane (docs/OBSERVABILITY.md): the dist smoke report must
+# carry the cardinality columns on every node line and a decision footer
+# whose structural entry count equals the static census
+ev = da.get("evidence") or {}
+assert ev.get("node_lines_annotated") is True, \
+    "EXPLAIN node lines missing est_rows/q_error: %r" % ev
+assert ev.get("footer_rendered") is True and ev.get("decisions", 0) > 0, ev
+assert ev.get("census_matches") is True, \
+    "decision ledger count != static census: %r" % ev
+print("evidence: %d decisions (%d pathed == census %d)" %
+      (ev["decisions"], ev["decisions_pathed"], ev["census"]))
+# the profile store carries the scored ledger + per-node q_error: some
+# stored profile (the dist subprocess queries ran distributed plans)
+# must have a decisions block, and some node must carry a q_error score
+import glob
+profs = [json.load(open(p))
+         for p in glob.glob(prof[0]["dir"] + "/profile-*.json")]
+assert any(p.get("decisions") for p in profs), \
+    "no stored profile carries a decision ledger"
+assert any(n.get("q_error") is not None
+           for p in profs for n in p.get("nodes", ())), \
+    "no stored profile node carries q_error"
+ndec = sum(len(p.get("decisions") or ()) for p in profs)
+print("profile evidence: %d decision entries across %d profiles" %
+      (ndec, len(profs)))
+mo = [s for s in snaps if s.get("metric") == "metrics_overhead"]
+assert mo and mo[0]["ok"], "metrics_overhead line missing or not ok"
+print("metrics overhead: on/off ratio %s (report-only gate key)" %
+      mo[0]["ratios"]["on_vs_off"])
+'
+
+# Prometheus exposition: one local scrape through tools/srjt_export.py,
+# parsed line-by-line as text exposition format (every line a comment or
+# a srjt_-prefixed sample; histogram buckets cumulative)
+JAX_PLATFORMS=cpu python tools/srjt_export.py --warm \
+    > target/smoke-scrape.prom
+python -c '
+lines = [l.rstrip("\n") for l in open("target/smoke-scrape.prom") if l.strip()]
+assert lines, "empty Prometheus scrape"
+samples = 0
+for l in lines:
+    if l.startswith("# TYPE "):
+        parts = l.split()
+        assert len(parts) == 4 and parts[3] in ("counter", "gauge",
+                                                "histogram"), l
+        continue
+    assert l.startswith("srjt_"), "non-exposition line: %r" % l
+    name_labels, value = l.rsplit(" ", 1)
+    float(value)  # every sample value parses as a number
+    samples += 1
+assert samples > 0
+assert any("_bucket{le=" in l for l in lines), "no histogram buckets"
+print("prometheus scrape: %d samples parse as text exposition" % samples)
 '
 
 # bench regression gate: ENFORCED for the smoke-line ratio keys that have

@@ -268,11 +268,23 @@ class BridgeClient:
     def release(self, handle: int) -> None:
         self._call(P.OP_RELEASE, struct.pack("<Q", handle))
 
-    def metrics(self) -> dict:
+    def metrics(self, prefix: str = "") -> dict:
         """Server observability snapshot (per-op counts, errors, busy time,
-        live handles, open shm exports) — SURVEY §5 metrics role."""
+        live handles, open shm exports) — SURVEY §5 metrics role.
+
+        ``prefix`` narrows the counter/histogram/gauge blocks server-side
+        (e.g. ``"engine.exchange"``); empty returns everything, matching
+        the pre-prefix wire behaviour."""
         import json
-        return json.loads(self._call(P.OP_METRICS))
+        return json.loads(self._call(P.OP_METRICS, prefix.encode()))
+
+    def query_status(self) -> list:
+        """Live progress of every in-flight query on the server (chunks
+        done/total, rows, bytes, ETA).  Like :meth:`cancel`, issue this
+        from a SECOND connection — a connection blocked awaiting its own
+        PLAN_EXECUTE reply cannot also carry the poll."""
+        import json
+        return json.loads(self._call(P.OP_QUERY_STATUS))["queries"]
 
     def live_count(self) -> int:
         (n,) = struct.unpack("<I", self._call(P.OP_LIVE_COUNT))

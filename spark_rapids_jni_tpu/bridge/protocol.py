@@ -74,6 +74,11 @@ OP_CANCEL = 24         # -> [u32 n] flips the cancellation token of every
 #                        in-flight PLAN_EXECUTE on the server (n = how
 #                        many); handled OUTSIDE the dispatch lock, like
 #                        OP_SHUTDOWN, so it can interrupt a running query
+OP_QUERY_STATUS = 25   # -> [json utf-8] live progress of every in-flight
+#                        query ({"queries": metrics.progress_snapshot()}:
+#                        chunks done/total, rows, bytes, ETA); handled
+#                        OUTSIDE the dispatch lock like OP_CANCEL, so a
+#                        second connection can poll a running PLAN_EXECUTE
 
 # OP_GROUPBY aggregation codes
 AGG_SUM, AGG_COUNT, AGG_MIN, AGG_MAX, AGG_MEAN = 0, 1, 2, 3, 4

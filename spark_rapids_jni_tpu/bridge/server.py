@@ -506,7 +506,7 @@ class BridgeServer:
         if opcode == P.OP_TABLE_META:
             return self._op_table_meta(payload)
         if opcode == P.OP_METRICS:
-            return self._op_metrics()
+            return self._op_metrics(payload)
         if opcode == P.OP_GET_COLUMN:
             return self._op_get_column(payload)
         if opcode == P.OP_MAKE_TABLE:
@@ -531,8 +531,14 @@ class BridgeServer:
             return self._op_plan_execute(payload)
         raise ValueError(f"unknown opcode {opcode}")
 
-    def _op_metrics(self) -> bytes:
+    def _op_metrics(self, payload: bytes = b"") -> bytes:
         import json
+        # optional payload = UTF-8 name prefix: narrows the counter /
+        # histogram / gauge blocks so pollers that chart one family
+        # (bench's exchange scrape, an exporter's engine.stream.* panel)
+        # don't ship the whole registry.  Empty payload = everything,
+        # byte-compatible with pre-prefix clients.
+        prefix = payload.decode("utf-8") if payload else ""
         snap = {"ops": dict(self._metrics["ops"]),
                 "errors": self._metrics["errors"],
                 "busy_s": round(self._metrics["busy_s"], 6),
@@ -547,9 +553,9 @@ class BridgeServer:
         # SRJT_METRICS layer (histograms as [le, count] pairs, gauges, and
         # recent per-query summaries) — all JSON-native by construction
         from ..utils import metrics, timeline, tracing
-        snap["counters"] = tracing.counters_snapshot()
-        snap["histograms"] = metrics.histograms_snapshot()
-        snap["gauges"] = metrics.gauges_snapshot()
+        snap["counters"] = tracing.counters_snapshot(prefix)
+        snap["histograms"] = metrics.histograms_snapshot(prefix)
+        snap["gauges"] = metrics.gauges_snapshot(prefix)
         snap["queries"] = metrics.recent_summaries()
         # per-device exchange attribution: the dev-suffixed gauges grouped
         # into one block JNI-side pollers can chart without name parsing
@@ -650,6 +656,20 @@ class BridgeServer:
                     self._log.info("OP_CANCEL flipped %d token(s)", n)
                     try:
                         P.send_msg(conn, P.STATUS_OK, struct.pack("<I", n))
+                    except OSError:  # dead OR slow peer (send deadline)
+                        return
+                    continue
+                if opcode == P.OP_QUERY_STATUS:
+                    # outside the dispatch lock, like OP_CANCEL: the point
+                    # is to observe a PLAN_EXECUTE that is holding that
+                    # lock right now.  Reads only the progress registry's
+                    # host-side dicts — zero device syncs added.
+                    import json as _json
+                    from ..utils import metrics as _metrics
+                    body = _json.dumps(
+                        {"queries": _metrics.progress_snapshot()}).encode()
+                    try:
+                        P.send_msg(conn, P.STATUS_OK, body)
                     except OSError:  # dead OR slow peer (send deadline)
                         return
                     continue

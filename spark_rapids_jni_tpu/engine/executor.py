@@ -722,6 +722,10 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
         cancel=ctx.recovery.cancel)
     stats["streamed"] = True
     stats["pipelined"] = ctx.prefetch > 0
+    pqm = metrics.current()
+    if pqm is not None:
+        # live-progress denominator from footer metadata (no page decode)
+        pqm.progress_total(reader.footer_chunk_estimate())
 
     seg = None
     if ctx.fuse and not force_interp:
@@ -781,10 +785,13 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                         # per-chunk latency is dispatch time — the fused
                         # loop never syncs per chunk, by design
                         dt = time.perf_counter() - tc0
+                        cb = table_nbytes(chunk)
                         qm.node_add(id(agg), node_label(agg), chunks=1,
                                     rows_in=int(nvalid),
-                                    bytes_in=table_nbytes(chunk),
+                                    bytes_in=cb,
                                     padded_rows=int(chunk.num_rows - nvalid))
+                        qm.progress_step(chunks=1, rows=int(nvalid),
+                                         nbytes=cb)
                         metrics.observe("engine.stream.chunk_latency_s", dt)
                         metrics.observe("engine.stream.chunk_rows",
                                         int(nvalid))
@@ -856,9 +863,11 @@ def _stream_partial(agg: Aggregate, scan: Scan, chunk: Table, memo: dict,
     t = _exec(agg.child, sub, stats, ctx)
     out = [_groupby(t, agg)] if t.num_rows else []
     if qm is not None:
+        cb = table_nbytes(chunk)
         qm.node_add(id(agg), node_label(agg), chunks=1,
                     rows_in=chunk.num_rows,
-                    bytes_in=table_nbytes(chunk))
+                    bytes_in=cb)
+        qm.progress_step(chunks=1, rows=chunk.num_rows, nbytes=cb)
         metrics.observe("engine.stream.chunk_latency_s",
                         time.perf_counter() - tc0)
         metrics.observe("engine.stream.chunk_rows", chunk.num_rows)
@@ -907,15 +916,19 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     buf_words: list = []          # their u64 sort words (incl. tiebreak)
     rows_seen = 0
     qm = metrics.current()
+    if qm is not None:
+        qm.progress_total(reader.footer_chunk_estimate())
     try:
         for chunk in reader:
             ctx.recovery.checkpoint()
             stats["chunks"] += 1
             tc0 = time.perf_counter() if qm is not None else 0.0
             if qm is not None:
+                cb = table_nbytes(chunk)
                 qm.node_add(id(node), node_label(node), chunks=1,
                             rows_in=chunk.num_rows,
-                            bytes_in=table_nbytes(chunk))
+                            bytes_in=cb)
+                qm.progress_step(chunks=1, rows=chunk.num_rows, nbytes=cb)
             sub = _ChunkMemo(memo)
             sub[id(scan)] = chunk
             t = _exec(node.child, sub, stats, ctx)
@@ -972,6 +985,32 @@ _EXEC_DISPATCH = {
     TopK: _exec_topk,
     Exchange: _exec_exchange,
 }
+
+
+def _stamp_plan_feedback(plan: PlanNode, qm) -> None:
+    """Post-run estimate-vs-actual join: copy the optimizer's evidence
+    (``_est_rows`` per node, the root's ``_decisions`` ledger) onto the
+    query's spans so summaries, EXPLAIN ANALYZE, and the profile store
+    carry ``est_rows``/``q_error`` per node and the decision ledger per
+    query.  Pure host-side dict work over spans the executor already
+    recorded; nodes without spans (fused-segment interiors) stay
+    untouched — EXPLAIN falls back to the plan attribute for those."""
+    from .plan import topo_nodes
+    from .verify import node_paths
+    paths = node_paths(plan)
+    for n in topo_nodes(plan):
+        rec = qm.node_spans.get(id(n))
+        if rec is None:
+            continue
+        fields = {"path": paths[id(n)]}
+        est = getattr(n, "_est_rows", None)
+        if est is not None:
+            fields["est_rows"] = int(est)
+            fields["q_error"] = metrics.q_error(est, rec.get("rows_out"))
+        qm.node_set(id(n), node_label(n), **fields)
+    dec = getattr(plan, "_decisions", None)
+    if dec:
+        qm.set_decisions(dec)
 
 
 def execute(plan: PlanNode, stats: Optional[dict] = None,
@@ -1038,6 +1077,9 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
         oq = qm if qm is not None else metrics.current()
         if oq is not None:
             oq.set_outcome("ok")
+            # estimate-vs-actual + decision-ledger handoff (optimizer
+            # stamped the plan; spans now hold the actuals)
+            _stamp_plan_feedback(plan, oq)
         if qm is not None:
             qm.note_stats(stats)
             # query-boundary device-memory sample: with the chunk-boundary

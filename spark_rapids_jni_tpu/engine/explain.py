@@ -112,14 +112,33 @@ def _roofline(span: dict, ceiling: Optional[float]) -> dict:
     return out
 
 
-def _annotate(span: Optional[dict], ceiling: Optional[float] = None) -> str:
+def _est_bits(span: Optional[dict], node: Optional[PlanNode]) -> list:
+    """The cardinality-ledger columns: planner estimate + q-error.
+
+    ``est_rows`` prefers the span (the executor stamps it post-run) and
+    falls back to the optimizer's ``_est_rows`` plan attribute, so nodes
+    a fused segment swallowed (no span) still show their estimate;
+    unknown estimates render ``?`` rather than vanishing."""
+    est = None if span is None else span.get("est_rows")
+    if est is None and node is not None:
+        est = getattr(node, "_est_rows", None)
+    qe = None if span is None else span.get("q_error")
+    if qe is None and est is not None and span is not None:
+        qe = metrics.q_error(est, span.get("rows_out"))
+    return [f"est_rows={'?' if est is None else est}",
+            f"q_error={'?' if qe is None else format(qe, '.2f')}"]
+
+
+def _annotate(span: Optional[dict], ceiling: Optional[float] = None,
+              node: Optional[PlanNode] = None) -> str:
     """The ANALYZE half: bracketed span fields for one node line."""
     if span is None:
-        return "[not executed]"
+        return "[not executed " + " ".join(_est_bits(None, node)) + "]"
     bits = [f"calls={span['calls']}",
             f"wall={span['wall_s'] * 1e3:.2f}ms",
             f"rows_in={span['rows_in']}",
             f"rows_out={span['rows_out']}"]
+    bits.extend(_est_bits(span, node))
     if span["chunks"]:
         bits.append(f"chunks={span['chunks']}")
     if span["padded_rows"]:
@@ -157,6 +176,32 @@ def _annotate(span: Optional[dict], ceiling: Optional[float] = None) -> str:
     return "[" + " ".join(bits) + "]"
 
 
+def _decision_line(d: dict, actuals: dict) -> str:
+    """One footer line for one optimizer-ledger entry, scored against the
+    actual rows observed at the decision's node (when it executed)."""
+    bits = [d.get("kind", "?")]
+    path = d.get("path")
+    if path:
+        bits.append(f"path={path}")
+    for k in ("side", "how", "exchange", "inner", "n", "keys", "aggs"):
+        v = d.get(k)
+        if v not in (None, [], ()):
+            bits.append(f"{k}={','.join(map(str, v))}"
+                        if isinstance(v, (list, tuple)) else f"{k}={v}")
+    if "est_rows" in d:
+        e = d["est_rows"]
+        bits.append(f"est_rows={'?' if e is None else e}")
+    if "threshold" in d:
+        bits.append(f"threshold={d['threshold']}")
+    act = actuals.get(path) if path else None
+    if act is not None:
+        bits.append(f"actual_rows={act}")
+        qe = metrics.q_error(d.get("est_rows"), act)
+        if qe is not None:
+            bits.append(f"q_error={qe:.2f}")
+    return " ".join(bits)
+
+
 @dataclass
 class ExplainReport:
     """Structured EXPLAIN ANALYZE output; ``str(report)`` is the tree."""
@@ -165,6 +210,7 @@ class ExplainReport:
     nodes: list = field(default_factory=list)   # topo order, root last
     summary: dict = field(default_factory=dict)  # QueryMetrics.summary()
     result: Optional[Table] = None
+    decisions: list = field(default_factory=list)  # optimizer ledger
 
     def __str__(self) -> str:
         return self.text
@@ -187,7 +233,7 @@ def _render(root: PlanNode, spans: dict,
             return
         seen.add(id(node))
         lines.append(f"{pad}{_describe(node)}  "
-                     f"{_annotate(spans.get(id(node)), ceiling)}")
+                     f"{_annotate(spans.get(id(node)), ceiling, node)}")
         for child in node.children():
             walk(child, depth + 1)
 
@@ -197,18 +243,21 @@ def _render(root: PlanNode, spans: dict,
 
 def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
                     fused: Optional[bool] = None,
-                    prefetch: Optional[int] = None) -> ExplainReport:
+                    prefetch: Optional[int] = None,
+                    distribute: Optional[bool] = None) -> ExplainReport:
     """Optimize + execute ``plan`` and report per-node metrics.
 
     ``fused``/``prefetch`` pass through to ``execute`` (so both executor
-    modes can be profiled on the same plan).  With ``SRJT_METRICS=0`` the
-    plan still runs and the tree still renders, but node annotations and
-    the summary are empty.
+    modes can be profiled on the same plan); ``distribute`` passes through
+    to ``optimize`` (so the distributed plan's decision ledger and
+    exchange telemetry render in the same report).  With ``SRJT_METRICS=0``
+    the plan still runs and the tree still renders, but node annotations
+    and the summary are empty.
     """
     from .executor import execute, new_stats
     from .optimizer import optimize
 
-    opt = optimize(plan)
+    opt = optimize(plan, distribute=distribute)
     if stats is None:
         stats = new_stats()
     qm = None
@@ -228,6 +277,7 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
     from .plan import topo_nodes
     nodes = [{"label": node_label(n),
               "desc": _describe(n),
+              "est_rows": getattr(n, "_est_rows", None),
               "metrics": None if id(n) not in spans else
               {**spans[id(n)], **_roofline(spans[id(n)], ceiling)}}
              for n in topo_nodes(opt)]
@@ -264,6 +314,20 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
                 line += " degraded=" + ",".join(
                     d.get("step", "?") for d in degr)
             foot.append(line)
+        decisions = getattr(opt, "_decisions", None)
+        if decisions:
+            # the decision-ledger footer: one line per optimizer decision,
+            # scored against the actual rows the decision's node saw.
+            # verify.decision_census(opt) counts the same structural
+            # entries statically — bench/CI assert the counts match.
+            from .verify import node_paths
+            actuals = {p: spans[i].get("rows_out")
+                       for i, p in node_paths(opt).items() if i in spans}
+            foot.append(f"-- decisions ({len(decisions)}):")
+            for d in decisions:
+                foot.append("--   " + _decision_line(d, actuals))
         text = text + "\n" + "\n".join(foot)
     return ExplainReport(text=text, nodes=nodes, summary=summary,
-                         result=out)
+                         result=out,
+                         decisions=[dict(d) for d in
+                                    getattr(opt, "_decisions", None) or ()])

@@ -165,20 +165,22 @@ def _rename_expr(expr, mapping):
 
 # -- rule 0: ORDER BY ... LIMIT -> TopK ------------------------------------
 
-def _fuse_topk(node: PlanNode, memo: dict) -> PlanNode:
+def _fuse_topk(node: PlanNode, memo: dict, dec: list) -> PlanNode:
     """``Limit(Sort(x), n)`` becomes ``TopK(x, keys, n)`` — semantically
     identical (sort-then-slice), but the fused node tells the executor the
     full sorted table is never observed, so a streaming partial top-k
     (capacity-n device buffer, merged once) is a legal physical plan."""
     if id(node) in memo:
         return memo[id(node)]
-    kids = {f: _fuse_topk(getattr(node, f), memo)
+    kids = {f: _fuse_topk(getattr(node, f), memo, dec)
             for f in ("child", "left", "right") if hasattr(node, f)}
     out = rebuild(node, **{k: v for k, v in kids.items()
                            if v is not getattr(node, k)})
     if isinstance(out, Limit) and isinstance(out.child, Sort):
         srt = out.child
         out = TopK(srt.child, srt.keys, out.n)
+        dec.append({"kind": "topk", "n": out.n,
+                    "keys": [c for c, _ in out.keys]})
     memo[id(node)] = out
     return out
 
@@ -407,7 +409,7 @@ def _estimate_rows(node: PlanNode, memo: dict) -> Optional[int]:
 
 
 def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
-                    memo: dict) -> PlanNode:
+                    memo: dict, dec: list) -> PlanNode:
     """Insert the minimal exchanges a distributed Join/Aggregate needs.
 
     Bottom-up so each decision sees the children's (possibly already
@@ -432,7 +434,7 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
     """
     if id(node) in memo:
         return memo[id(node)]
-    kids = {f: _plan_exchanges(getattr(node, f), pmemo, est, memo)
+    kids = {f: _plan_exchanges(getattr(node, f), pmemo, est, memo, dec)
             for f in ("child", "left", "right") if hasattr(node, f)}
     out = rebuild(node, **{k: v for k, v in kids.items()
                            if v is not getattr(node, k)})
@@ -451,14 +453,27 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
                     and rows <= config.broadcast_rows:
                 out = rebuild(out, right=Exchange(out.right,
                                                   kind="broadcast"))
+                dec.append({"kind": "broadcast", "how": out.how,
+                            "est_rows": int(rows),
+                            "threshold": int(config.broadcast_rows)})
             elif out.how != "cross":
                 left, right = out.left, out.right
                 if not (lp.kind == "hash"
                         and tuple(lp.keys) == tuple(out.left_keys)):
                     left = Exchange(left, out.left_keys, "hash")
+                    lrows = _estimate_rows(out.left, est)
+                    dec.append({"kind": "shuffle", "side": "left",
+                                "keys": list(out.left_keys),
+                                "est_rows": lrows,
+                                "build_est_rows": rows,
+                                "threshold": int(config.broadcast_rows)})
                 if not (rp.kind == "hash"
                         and tuple(rp.keys) == tuple(out.right_keys)):
                     right = Exchange(right, out.right_keys, "hash")
+                    dec.append({"kind": "shuffle", "side": "right",
+                                "keys": list(out.right_keys),
+                                "est_rows": rows,
+                                "threshold": int(config.broadcast_rows)})
                 out = rebuild(out, left=left, right=right)
     elif isinstance(out, Aggregate):
         from .executor import _STREAM_COMBINE
@@ -470,6 +485,10 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
             # pre-pass subtree so no planner-placed exchange can silently
             # reorder rows anywhere below this aggregate
             out = node
+            dec.append({"kind": "order_sensitive_revert",
+                        "keys": list(node.keys),
+                        "aggs": sorted({op for _, op in node.aggs
+                                        if op in ORDER_SENSITIVE_AGGS})})
         elif not out.keys:
             pass  # ungrouped: one global group, no placement to satisfy
         elif p.kind == "broadcast" or (p.kind == "hash"
@@ -485,20 +504,26 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
                             for nm, (_c, op) in zip(out.names, out.aggs))
             out = Aggregate(Exchange(partial, out.keys, "hash"),
                             out.keys, combine, out.names)
+            dec.append({"kind": "partial_agg", "keys": list(out.keys),
+                        "est_rows": _estimate_rows(node, est)})
         else:
             out = rebuild(out, child=Exchange(out.child, out.keys, "hash"))
+            dec.append({"kind": "shuffle", "side": "aggregate",
+                        "keys": list(out.keys),
+                        "est_rows": _estimate_rows(node, est)})
     memo[id(node)] = out
     return out
 
 
-def _eliminate_exchanges(node: PlanNode, pmemo: dict, memo: dict) -> PlanNode:
+def _eliminate_exchanges(node: PlanNode, pmemo: dict, memo: dict,
+                         dec: list) -> PlanNode:
     """Drop exchanges whose child is already placed the way they'd place
     it, and collapse back-to-back exchanges (only the outer placement
     survives the wire anyway) — the cleanup pass for hand-built plans that
     carry explicit Exchange nodes."""
     if id(node) in memo:
         return memo[id(node)]
-    kids = {f: _eliminate_exchanges(getattr(node, f), pmemo, memo)
+    kids = {f: _eliminate_exchanges(getattr(node, f), pmemo, memo, dec)
             for f in ("child", "left", "right") if hasattr(node, f)}
     out = rebuild(node, **{k: v for k, v in kids.items()
                            if v is not getattr(node, k)})
@@ -506,10 +531,17 @@ def _eliminate_exchanges(node: PlanNode, pmemo: dict, memo: dict) -> PlanNode:
         p = partitioning(out.child, pmemo)
         if out.kind == "hash" and p.kind == "hash" \
                 and tuple(p.keys) == tuple(out.keys):
+            dec.append({"kind": "exchange_eliminated", "exchange": "hash",
+                        "keys": list(out.keys)})
             out = out.child  # child rows already live where we'd send them
         elif out.kind == "broadcast" and p.kind == "broadcast":
+            dec.append({"kind": "exchange_eliminated",
+                        "exchange": "broadcast", "keys": []})
             out = out.child
         elif isinstance(out.child, Exchange):
+            dec.append({"kind": "exchange_folded",
+                        "inner": out.child.kind,
+                        "keys": list(out.child.keys)})
             out = rebuild(out, child=out.child.child)
         else:
             break
@@ -518,6 +550,44 @@ def _eliminate_exchanges(node: PlanNode, pmemo: dict, memo: dict) -> PlanNode:
 
 
 # -- driver ----------------------------------------------------------------
+
+def _stamp_evidence(plan: PlanNode, decisions: list, dist: bool) -> None:
+    """Attach the cardinality + decision ledger to the optimized plan.
+
+    Every node gets an ``_est_rows`` attribute (the ``_estimate_rows``
+    upper bound, None = unknown) and the root gets ``_decisions`` — both
+    as plain object attributes, NOT dataclass fields, so canonical
+    serialization and plan fingerprints stay byte-identical.  Unknown
+    estimates tick ``engine.estimate.unknown`` (one per blind node per
+    optimize) so un-scorable plans are visible instead of silent.
+
+    Structural decisions (broadcast / shuffle / partial_agg / topk /
+    order_sensitive_revert) are assigned their dotted path in the FINAL
+    plan by zipping, per kind and in postorder, against
+    ``verify.decision_census`` — the same static census the CI assertion
+    compares the EXPLAIN footer against.  Elimination/fold entries left
+    no structure behind and carry no path.
+    """
+    est_memo: dict = {}
+    unknown = 0
+    for n in topo_nodes(plan):
+        e = _estimate_rows(n, est_memo)
+        if e is None:
+            unknown += 1
+        object.__setattr__(n, "_est_rows", e)
+    if unknown:
+        from ..utils import metrics
+        metrics.count("engine.estimate.unknown", unknown)
+    from .verify import decision_census
+    by_kind: dict = {}
+    for c in decision_census(plan, dist=dist):
+        by_kind.setdefault(c["kind"], []).append(c)
+    for d in decisions:
+        q = by_kind.get(d["kind"])
+        if q:
+            d["path"] = q.pop(0)["path"]
+    object.__setattr__(plan, "_decisions", decisions)
+
 
 def optimize(plan: PlanNode,
              distribute: Optional[bool] = None) -> PlanNode:
@@ -534,6 +604,11 @@ def optimize(plan: PlanNode,
     call; the default follows ``SRJT_DIST``.  Shuffle elimination
     (``_eliminate_exchanges``) also runs on plans that carry hand-placed
     Exchange nodes even when distribution is off.
+
+    The optimized plan carries the AQE evidence plane: per-node
+    ``_est_rows`` and a root ``_decisions`` ledger (see
+    ``_stamp_evidence``) that EXPLAIN, the executor, and the profile
+    store consume.
     """
     from ..utils.config import config
     checker = None
@@ -541,7 +616,8 @@ def optimize(plan: PlanNode,
         from .verify import RewriteChecker
         checker = RewriteChecker(plan)
     schema = _Schema()
-    plan = _fuse_topk(plan, {})
+    decisions: list = []
+    plan = _fuse_topk(plan, {}, decisions)
     if checker is not None:
         checker.check("fuse_topk", plan)
     plan = _push_filters(plan, schema, {})
@@ -552,11 +628,11 @@ def optimize(plan: PlanNode,
         checker.check("push_scan_predicates", plan)
     dist = config.distribute if distribute is None else bool(distribute)
     if dist:
-        plan = _plan_exchanges(plan, {}, {}, {})
+        plan = _plan_exchanges(plan, {}, {}, {}, decisions)
         if checker is not None:
             checker.check("plan_exchanges", plan)
     if dist or any(isinstance(n, Exchange) for n in topo_nodes(plan)):
-        plan = _eliminate_exchanges(plan, {}, {})
+        plan = _eliminate_exchanges(plan, {}, {}, decisions)
         if checker is not None:
             checker.check("eliminate_exchanges", plan)
     req: dict = {}
@@ -567,4 +643,5 @@ def optimize(plan: PlanNode,
     if dist and config.verify:
         from .verify import check_partitioning
         check_partitioning(plan)
+    _stamp_evidence(plan, decisions, dist)
     return plan

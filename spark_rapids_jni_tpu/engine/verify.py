@@ -496,6 +496,59 @@ def plan_exchanges(plan: PlanNode) -> list:
             for n in topo_nodes(plan) if isinstance(n, Exchange)]
 
 
+def decision_census(plan: PlanNode, dist: bool | None = None) -> list:
+    """Static census of decision-evidencing structures in an OPTIMIZED
+    plan, in postorder — one entry ``{"kind", "path"}`` per structure.
+
+    The planner's structural decisions all leave a fingerprint in the
+    plan shape: a broadcast choice is an ``Exchange(broadcast)``, a hash
+    placement is an ``Exchange(hash)``, a partial-agg split is the
+    ``Aggregate(Exchange(hash, Aggregate))`` sandwich (whose inner
+    exchange belongs to the split, not counted separately), a TopK
+    rewrite is the ``TopK`` node, and an order-sensitive revert is a
+    distributed Aggregate still carrying order-sensitive ops.  So for a
+    planner-optimized plan (no hand-placed exchanges) this census equals,
+    kind for kind, the structural entries of the plan's ``_decisions``
+    ledger — ci/premerge.sh and the bench dist script assert exactly
+    that against the EXPLAIN footer.  Elimination/fold decisions remove
+    structure and are deliberately absent here.
+
+    ``dist`` gates the order-sensitive-revert entries (the revert only
+    happens when exchange planning ran); default follows ``SRJT_DIST``.
+    """
+    if dist is None:
+        from ..utils.config import config
+        dist = config.distribute
+    from .plan import ORDER_SENSITIVE_AGGS
+    paths = node_paths(plan)
+    partial_exchanges = set()
+    for n in topo_nodes(plan):
+        if isinstance(n, Aggregate) and isinstance(n.child, Exchange) \
+                and n.child.kind == "hash" \
+                and isinstance(n.child.child, Aggregate) \
+                and tuple(n.child.child.keys) == tuple(n.keys) \
+                and tuple(n.child.child.names) == tuple(n.names):
+            partial_exchanges.add(id(n.child))
+    out = []
+    for n in topo_nodes(plan):
+        if isinstance(n, TopK):
+            out.append({"kind": "topk", "path": paths[id(n)]})
+        elif isinstance(n, Exchange):
+            if id(n) in partial_exchanges:
+                continue  # owned by the combine Aggregate's split entry
+            out.append({"kind": "broadcast" if n.kind == "broadcast"
+                        else "shuffle", "path": paths[id(n)]})
+        elif isinstance(n, Aggregate):
+            if isinstance(n.child, Exchange) \
+                    and id(n.child) in partial_exchanges:
+                out.append({"kind": "partial_agg", "path": paths[id(n)]})
+            elif dist and any(op in ORDER_SENSITIVE_AGGS
+                              for _, op in n.aggs):
+                out.append({"kind": "order_sensitive_revert",
+                            "path": paths[id(n)]})
+    return out
+
+
 def check_partitioning(plan: PlanNode) -> None:
     """Partitioning-consistency check for distributed plans.
 

@@ -1117,6 +1117,22 @@ class ParquetChunkedReader:
         self.close()
         return False
 
+    def footer_chunk_estimate(self) -> int:
+        """Expected chunk count from footer metadata alone — no page
+        decode, no IO beyond the already-parsed footer.  Per non-pruned
+        row group: at least one chunk, plus one per ``pass_read_limit``
+        of the group's footer ``total_byte_size`` (the same
+        uncompressed-bytes scale the real slicer budgets with).  The
+        executor publishes this as the query's live-progress
+        ``chunks_total``; it is an estimate, not a promise."""
+        total = 0
+        for gi in range(self.file.num_row_groups):
+            if self._group_pruned(gi):
+                continue
+            nbytes = int(self.file.row_groups[gi].total_byte_size or 0)
+            total += max(1, -(-nbytes // self.limit))
+        return total
+
     def _group_pruned(self, gi: int) -> bool:
         if self.predicate is None:
             return False

@@ -54,6 +54,13 @@ _lock = threading.Lock()
 _hists: dict[str, dict] = {}
 _gauges: dict[str, float] = {}
 
+#: live-query progress registry: qid -> the QueryMetrics itself.  Entries
+#: register at QueryMetrics construction and leave at ``finish()``, so the
+#: registry IS the set of in-flight queries — the bridge's OP_QUERY_STATUS
+#: and ``progress_snapshot()`` read it from any thread while the query
+#: runs.  Writes ride the per-query lock; no device work anywhere.
+_progress: dict[int, "QueryMetrics"] = {}
+
 #: completed-query summaries, newest last (the bridge/bench export window)
 _RECENT_LIMIT = 32
 _recent: "deque[dict]" = deque(maxlen=_RECENT_LIMIT)
@@ -139,6 +146,20 @@ def _hist_load(d: dict) -> dict:
             "buckets": {float(le): n for le, n in d["buckets"]}}
 
 
+def q_error(est, actual) -> float | None:
+    """Cardinality q-error: ``max(est/actual, actual/est)``, the symmetric
+    misestimate factor the AQE literature scores planners by (1.0 =
+    perfect).  Zeros clamp to 1 row so empty results stay finite — an
+    est=1000 that saw 0 rows scores 1000x, not inf.  ``None`` estimate
+    (unknown cardinality) returns None: un-scorable, counted separately
+    by ``engine.estimate.unknown``."""
+    if est is None:
+        return None
+    e = max(float(est), 1.0)
+    a = max(float(actual or 0), 1.0)
+    return round(max(e / a, a / e), 4)
+
+
 # -- per-query context ------------------------------------------------------
 
 _NODE_FIELDS = ("calls", "wall_s", "rows_in", "rows_out", "chunks",
@@ -158,7 +179,8 @@ class QueryMetrics:
 
     __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
                  "node_spans", "hists", "timers", "mem", "fingerprint",
-                 "outcome", "degradations", "_lock")
+                 "outcome", "degradations", "decisions", "progress",
+                 "_lock")
 
     def __init__(self, name: str = ""):
         self.qid = next(_qids)
@@ -174,7 +196,13 @@ class QueryMetrics:
         self.fingerprint: str = ""  # plan fingerprint (profile-store key)
         self.outcome: dict = {}  # status/kind/error (engine/recovery.py)
         self.degradations: list = []  # ladder steps taken (step, cause)
+        self.decisions: list = []  # optimizer ledger (plan._decisions)
+        # live progress counters, published at chunk boundaries
+        self.progress: dict = {"chunks_done": 0, "chunks_total": 0,
+                               "rows": 0, "bytes": 0}
         self._lock = threading.Lock()
+        with _lock:
+            _progress[self.qid] = self
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -254,6 +282,29 @@ class QueryMetrics:
         with self._lock:
             self.degradations.append({"step": step, "cause": cause})
 
+    def set_decisions(self, decisions) -> None:
+        """Adopt the optimizer's decision ledger (``plan._decisions``)."""
+        with self._lock:
+            self.decisions = [dict(d) for d in decisions]
+
+    def progress_total(self, chunks: int) -> None:
+        """Grow the expected-chunk total (footer metadata, per stream —
+        a query with several chunked scans accumulates each reader's
+        estimate)."""
+        with self._lock:
+            self.progress["chunks_total"] += int(chunks)
+
+    def progress_step(self, chunks: int = 0, rows: int = 0,
+                      nbytes: int = 0) -> None:
+        """Publish one chunk boundary: pure host-side dict increments
+        (the caller already holds the row/byte counts from buffer
+        metadata), so the execution hot path gains zero device syncs."""
+        with self._lock:
+            p = self.progress
+            p["chunks_done"] += int(chunks)
+            p["rows"] += int(rows)
+            p["bytes"] += int(nbytes)
+
     def set_outcome(self, status: str, kind: str = "",
                     error: str = "") -> None:
         """Stamp the query's terminal status (``ok`` | ``error``)."""
@@ -267,6 +318,8 @@ class QueryMetrics:
     def finish(self) -> None:
         if self.wall_s is None:
             self.wall_s = time.perf_counter() - self.t0
+        with _lock:
+            _progress.pop(self.qid, None)
 
     def summary(self) -> dict:
         """JSON-ready snapshot (safe to call live or after ``finish``)."""
@@ -292,6 +345,8 @@ class QueryMetrics:
                 out["outcome"] = dict(self.outcome)
             if self.degradations:
                 out["degradations"] = list(self.degradations)
+            if self.decisions:
+                out["decisions"] = [dict(d) for d in self.decisions]
             return out
 
 
@@ -463,6 +518,93 @@ def recent_summaries(limit: int | None = None) -> list:
     with _lock:
         out = list(_recent)
     return out if limit is None else out[-limit:]
+
+
+def progress_snapshot() -> list:
+    """One entry per in-flight query, qid order: chunk/row/byte progress
+    plus a derived ETA (remaining chunks x the query's own
+    ``engine.stream.chunk_latency_s`` p50 — the histogram the streaming
+    loops already feed, so the estimate costs the READER a percentile
+    walk and the running query nothing).  ``chunks_total`` is the footer
+    estimate (0 = no chunked stream opened yet)."""
+    with _lock:
+        live = list(_progress.values())
+    out = []
+    for qm in sorted(live, key=lambda q: q.qid):
+        with qm._lock:
+            p = dict(qm.progress)
+            h = qm.hists.get("engine.stream.chunk_latency_s")
+            p50 = _hist_percentiles(h, (0.5,))["p50"] if h else None
+            entry = {"qid": qm.qid, "name": qm.name,
+                     "fingerprint": qm.fingerprint,
+                     "wall_s": round(time.perf_counter() - qm.t0, 6),
+                     **p}
+        remaining = p["chunks_total"] - p["chunks_done"]
+        entry["eta_s"] = (round(remaining * p50, 6)
+                          if p50 is not None and remaining > 0 else None)
+        out.append(entry)
+    return out
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+def _prom_name(name: str) -> str:
+    """Dotted metric name -> exposition-safe name under the srjt_ prefix."""
+    safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return f"srjt_{safe}"
+
+
+def _prom_hist(name: str, h: dict, lines: list) -> None:
+    """Render one ``_hist_dump``-shaped histogram: cumulative le buckets
+    (power-of-two upper bounds) + the mandatory +Inf, _sum, _count."""
+    lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    for le, n in h.get("buckets", ()):
+        cum += n
+        lines.append(f'{name}_bucket{{le="{float(le):g}"}} {cum}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {h["count"]}')
+    lines.append(f"{name}_sum {float(h['sum']):g}")
+    lines.append(f"{name}_count {h['count']}")
+
+
+def prometheus_text(snap: dict | None = None, prefix: str = "") -> str:
+    """The whole counters/gauges/histograms registry in Prometheus text
+    exposition format (version 0.0.4) — hand-rolled, no client library.
+
+    ``snap`` accepts a ``snapshot()``-shaped dict (e.g. an OP_METRICS
+    reply decoded by ``tools/srjt_export.py``) so a scrape can render a
+    remote server's registry; default is this process's live registry.
+    Adds ``srjt_queries_in_flight`` and per-query progress gauges from
+    the progress registry (local scrapes only — a snapshot dict carries
+    no live progress)."""
+    if snap is None:
+        snap = {"counters": tracing.counters_snapshot(prefix),
+                "histograms": histograms_snapshot(prefix),
+                "gauges": gauges_snapshot(prefix),
+                "progress": progress_snapshot()}
+    lines: list[str] = []
+    for k in sorted(snap.get("counters") or {}):
+        name = _prom_name(k)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {snap['counters'][k]}")
+    for k in sorted(snap.get("gauges") or {}):
+        name = _prom_name(k)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(snap['gauges'][k]):g}")
+    for k in sorted(snap.get("histograms") or {}):
+        _prom_hist(_prom_name(k), snap["histograms"][k], lines)
+    progress = snap.get("progress")
+    if progress is not None:
+        lines.append("# TYPE srjt_queries_in_flight gauge")
+        lines.append(f"srjt_queries_in_flight {len(progress)}")
+        for g in ("chunks_done", "chunks_total", "rows", "bytes"):
+            name = f"srjt_query_progress_{g}"
+            if progress:
+                lines.append(f"# TYPE {name} gauge")
+                for e in progress:
+                    lines.append(f'{name}{{qid="{e["qid"]}",'
+                                 f'name="{e["name"]}"}} {e[g]}')
+    return "\n".join(lines) + "\n"
 
 
 def snapshot(prefix: str = "") -> dict:

@@ -48,7 +48,12 @@ _HIST_FIELDS = ("count", "sum", "mean", "min", "max", "p50", "p90", "p99")
 #: counts, exchange/shuffle traffic, bridge health, recovery activity
 _COUNTER_KEEP = ("engine.exchange", "parallel.shuffle", "bridge.",
                  "engine.errors", "engine.retries", "engine.degraded",
-                 "faults.injected")
+                 "engine.estimate", "faults.injected")
+
+#: decision/node q-error at or above this is a misestimate — the planner's
+#: input was off by >= 4x, enough to flip a broadcast-vs-shuffle choice
+#: (same module-constant convention as the diff thresholds below)
+_QERR_FLAG = 4.0
 
 
 def enabled() -> bool:
@@ -84,12 +89,15 @@ def compact(summary: dict) -> dict:
         moved = int(r.get("bytes_in") or 0) + int(r.get("bytes_out") or 0)
         gbps = (moved / wall / 1e9) if (moved and wall > 0) else None
         node = {"label": r.get("label", ""),
+                "path": r.get("path"),
                 "calls": int(r.get("calls") or 0),
                 "wall_s": round(wall, 6),
                 "rows_in": int(r.get("rows_in") or 0),
                 "rows_out": int(r.get("rows_out") or 0),
                 "chunks": int(r.get("chunks") or 0),
                 "host_syncs": int(r.get("host_syncs") or 0),
+                "est_rows": r.get("est_rows"),
+                "q_error": r.get("q_error"),
                 "bytes_moved": moved,
                 "GBps": round(gbps, 3) if gbps is not None else None,
                 "roofline_frac": (round(gbps / ceiling, 6)
@@ -125,7 +133,29 @@ def compact(summary: dict) -> dict:
         prof["outcome"] = dict(summary["outcome"])
     if summary.get("degradations"):
         prof["degradations"] = [dict(d) for d in summary["degradations"]]
+    if summary.get("decisions"):
+        by_path = {n["path"]: n for n in nodes if n.get("path")}
+        prof["decisions"] = [_score_decision(d, by_path)
+                             for d in summary["decisions"]]
     return prof
+
+
+def _score_decision(d: dict, by_path: dict) -> dict:
+    """Score one optimizer-ledger entry against the run's actuals: the
+    node at the decision's path supplies ``actual_rows``; the entry's own
+    ``est_rows`` supplies the estimate; q-error >= ``_QERR_FLAG`` marks a
+    misestimate (the broadcast-chosen-on-est=50k-that-saw-5M case the
+    diff flags and ``srjt_profile decisions`` browses)."""
+    from . import metrics
+    out = dict(d)
+    node = by_path.get(d.get("path"))
+    if node is not None:
+        out["actual_rows"] = node.get("rows_out")
+        qe = metrics.q_error(d.get("est_rows"), node.get("rows_out"))
+        if qe is not None:
+            out["q_error"] = qe
+            out["misestimate"] = qe >= _QERR_FLAG
+    return out
 
 
 def write(summary: dict, dir_path: str | None = None) -> str | None:
@@ -243,7 +273,9 @@ def diff(base: dict | str, cand: dict | str) -> dict:
         wa = (an.get(label) or {}).get("wall_s") or 0.0
         wb = (bn.get(label) or {}).get("wall_s") or 0.0
         d = {"label": label, "wall_s_base": wa, "wall_s_cand": wb,
-             "wall_s_delta": round(wb - wa, 6)}
+             "wall_s_delta": round(wb - wa, 6),
+             "q_error_base": (an.get(label) or {}).get("q_error"),
+             "q_error_cand": (bn.get(label) or {}).get("q_error")}
         nodes.append(d)
         if wb - wa > _SLOW_ABS_S and (wa == 0 or wb / wa > 1 + _SLOW_FRAC):
             flags.append(f"node-slowed: {label} "
@@ -288,6 +320,19 @@ def diff(base: dict | str, cand: dict | str) -> dict:
     ob, oc = a.get("outcome") or {}, b.get("outcome") or {}
     if oc.get("status") == "error" and ob.get("status") != "error":
         flags.append(f"outcome-error: kind={oc.get('kind', '?')}")
+    # misestimate attribution: a candidate decision whose planner input was
+    # off by >= _QERR_FLAG when the base run's wasn't means the cardinality
+    # feed regressed (stats drifted, estimate path broke) — flag it even if
+    # the plan happened to stay fast on this data
+    base_mis = {(d.get("kind"), d.get("path"))
+                for d in a.get("decisions", ()) if d.get("misestimate")}
+    for d in b.get("decisions", ()):
+        if d.get("misestimate") and \
+                (d.get("kind"), d.get("path")) not in base_mis:
+            flags.append(
+                f"misestimate: {d.get('kind', '?')} at {d.get('path', '?')} "
+                f"est={d.get('est_rows')} actual={d.get('actual_rows')} "
+                f"q_error={d.get('q_error')}")
     return {"fingerprint": a.get("fingerprint", ""),
             "fingerprint_match":
                 a.get("fingerprint", "") == b.get("fingerprint", ""),

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Prometheus text-exposition exporter for the engine metrics registries.
+
+One scrape = one dump of the counter/gauge/histogram registries (plus the
+live-query progress gauges) in Prometheus text exposition format v0.0.4 —
+pipe it into a node_exporter textfile collector, a pushgateway, or curl's
+stdin.  Two sources:
+
+- ``--socket PATH``: scrape a *running bridge server* over ``OP_METRICS``
+  (second connection; does not disturb in-flight queries).  ``--prefix``
+  narrows the blocks server-side before they cross the wire.
+- no socket: dump this process's own registries.  That is only useful
+  after something ran in-process, so ``--warm`` first executes a tiny
+  generated query to populate them — the CI smoke path that validates the
+  exposition format end to end.
+
+Usage::
+
+    python tools/srjt_export.py --socket /tmp/bridge.sock [--prefix engine.]
+    python tools/srjt_export.py --warm [--prefix engine.stream]
+
+Exit code 0 on success, 2 on usage errors (dead socket, empty registry
+without --warm).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from spark_rapids_jni_tpu.utils import metrics  # noqa: E402
+
+
+def _warm_query() -> None:
+    """Run one tiny in-process aggregate so the registries have content —
+    scan + groupby over a generated parquet file, a few KB of work."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.engine import Aggregate, Scan, execute, optimize
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "warm.parquet")
+        rng = np.random.default_rng(11)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 8, 512).astype(np.int64)),
+            "v": pa.array(rng.uniform(0.0, 1.0, 512)),
+        }), path, row_group_size=128)
+        plan = Aggregate(Scan(path, chunk_bytes=2_048), ["k"],
+                         [("v", "sum")], names=["s"])
+        with metrics.query("export:warm"):
+            execute(optimize(plan))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srjt_export", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--socket", default=None,
+                    help="bridge server unix socket to scrape over "
+                         "OP_METRICS (default: this process's registries)")
+    ap.add_argument("--prefix", default="",
+                    help="metric-name prefix filter (e.g. engine.stream)")
+    ap.add_argument("--warm", action="store_true",
+                    help="no-socket mode: run a tiny query first so the "
+                         "local registries have content")
+    args = ap.parse_args(argv)
+
+    if args.socket:
+        from spark_rapids_jni_tpu.bridge import BridgeClient
+        try:
+            client = BridgeClient(args.socket)
+        except OSError as e:
+            print(f"cannot connect to {args.socket}: {e}", file=sys.stderr)
+            return 2
+        try:
+            snap = client.metrics(prefix=args.prefix)
+        finally:
+            client.close()
+        # the server already applied the prefix; render its snapshot
+        sys.stdout.write(metrics.prometheus_text(snap=snap))
+        return 0
+
+    if args.warm:
+        _warm_query()
+    text = metrics.prometheus_text(prefix=args.prefix)
+    if not text.strip():
+        print("local registries are empty (run under a query, or pass "
+              "--warm / --socket)", file=sys.stderr)
+        return 2
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        code = main()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe mid-print: normal exit,
+        # but devnull stdout first so interpreter teardown can't re-raise
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    raise SystemExit(code)

@@ -8,9 +8,10 @@ on-disk ring, safe to run anywhere the directory is mounted.
 
 Usage::
 
-    python tools/srjt_profile.py list  [--dir DIR]
-    python tools/srjt_profile.py show  [--dir DIR] [PATH|-1]
-    python tools/srjt_profile.py diff  [--dir DIR] [BASE CAND]
+    python tools/srjt_profile.py list      [--dir DIR]
+    python tools/srjt_profile.py show      [--dir DIR] [PATH|-1]
+    python tools/srjt_profile.py diff      [--dir DIR] [BASE CAND]
+    python tools/srjt_profile.py decisions [--dir DIR] [PATH|-1]
 
 ``diff`` with no positional arguments picks the two newest profiles
 sharing a plan fingerprint (the cross-run EXPLAIN ANALYZE comparison);
@@ -119,6 +120,42 @@ def cmd_diff(args) -> int:
     return 0
 
 
+def cmd_decisions(args) -> int:
+    """Render one profile's optimizer decision ledger, scored against the
+    run's actuals: the EXPLAIN footer, replayable after the fact."""
+    path = _resolve(_dir_of(args), args.path)
+    prof = profile.read(path)
+    dec = prof.get("decisions") or []
+    print(f"{os.path.basename(path)}  name={prof.get('name', '')!r} "
+          f"decisions={len(dec)}")
+    if not dec:
+        print("  (no decisions recorded — pre-ledger profile or "
+              "single-device plan with no rewrites)")
+        return 0
+    for d in dec:
+        bits = [d.get("kind", "?")]
+        if d.get("path"):
+            bits.append(f"path={d['path']}")
+        for k in ("side", "how", "exchange", "inner", "n"):
+            if d.get(k) is not None:
+                bits.append(f"{k}={d[k]}")
+        if d.get("keys"):
+            bits.append("keys=" + ",".join(map(str, d["keys"])))
+        if d.get("aggs"):
+            bits.append("aggs=" + ",".join(map(str, d["aggs"])))
+        if "est_rows" in d:
+            bits.append(f"est={d['est_rows'] if d['est_rows'] is not None else '?'}")
+        if d.get("threshold") is not None:
+            bits.append(f"threshold={d['threshold']}")
+        if "actual_rows" in d:
+            bits.append(f"actual={d['actual_rows']}")
+        if d.get("q_error") is not None:
+            bits.append(f"q_error={d['q_error']:.2f}")
+        flag = "  ! MISESTIMATE" if d.get("misestimate") else ""
+        print("  " + " ".join(bits) + flag)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="srjt_profile", description=__doc__,
@@ -136,8 +173,14 @@ def main(argv=None) -> int:
     p_diff.add_argument("cand", nargs="?", default=None)
     p_diff.add_argument("--json", action="store_true",
                         help="emit the structured diff instead of the table")
+    p_dec = sub.add_parser(
+        "decisions", help="optimizer decision ledger of one profile, "
+                          "scored against the run's actuals")
+    p_dec.add_argument("path", nargs="?", default=None,
+                       help="path, filename, or negative index (-1 = newest)")
     args = ap.parse_args(argv)
-    return {"list": cmd_list, "show": cmd_show, "diff": cmd_diff}[args.cmd](args)
+    return {"list": cmd_list, "show": cmd_show, "diff": cmd_diff,
+            "decisions": cmd_decisions}[args.cmd](args)
 
 
 if __name__ == "__main__":
