@@ -13,6 +13,14 @@ ci/premerge.sh
 JAX_PLATFORMS=cpu python tools/srjt_lint.py --segments --full \
     --baseline ci/lint-baseline.json
 
+# nightly fuzz sweep: a bigger corpus on a fresh seed over the EXTENDED
+# variant matrix (adds dist-nofuse + interp-notopk).  The shrunk repro
+# artifact lands in target/fuzz-repro.json on failure — re-run with the
+# printed seed to reproduce deterministically.
+JAX_PLATFORMS=cpu python tools/srjt_fuzz.py \
+    --seed "$(date +%Y%m%d)" --count 150 --full \
+    --out target/fuzz-repro.json
+
 # chaos soak: the fault-injection matrix against the pipeline plans
 # (docs/ROBUSTNESS.md).  `timeout` is the outermost hang detector — a soak
 # that can't finish inside 15 minutes IS a robustness failure.

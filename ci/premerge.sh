@@ -25,6 +25,14 @@ cmake --build target/cpp-build
 JAX_PLATFORMS=cpu python tools/srjt_lint.py --segments \
     --baseline ci/lint-baseline.json
 
+# rewrite-soundness fuzz smoke (docs/ANALYSIS.md): 50 seeded plans swept
+# through the flag matrix (interp/fused/dist-shuffle/dist-broadcast) with
+# verify-after-rewrite, ledger==census, exchange census, sync whitelist,
+# bit-exact executor parity, and pandas-oracle parity asserted per plan.
+# Zero soundness violations required; failures print a shrunk minimal
+# repro (seed + plan JSON).
+JAX_PLATFORMS=cpu python tools/srjt_fuzz.py --smoke
+
 # full suite on the virtual 8-device CPU mesh (includes bridge round trip)
 python -m pytest tests/ -q
 

@@ -22,20 +22,16 @@ from . import protocol as P
 from . import shm as shmlib
 from ..columnar import Column, Table
 from ..dtypes import DType, TypeId
+from ..utils.config import child_environ
 from ..utils.errors import BridgeTimeoutError, from_wire
 
 
 def spawn_server(sock_path: str, env: dict | None = None,
                  timeout: float = 60.0) -> subprocess.Popen:
     """Start a device-server subprocess and wait for its socket."""
-    e = dict(os.environ)
-    # default the server onto CPU unless the caller says otherwise — a second
-    # process contending for a one-tenant TPU tunnel hangs at backend init
-    e.setdefault("JAX_PLATFORMS", "cpu")
-    # make the package importable regardless of the caller's cwd
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    e["PYTHONPATH"] = pkg_root + os.pathsep + e.get("PYTHONPATH", "")
+    # CPU default + PYTHONPATH: a second process contending for a
+    # one-tenant TPU tunnel hangs at backend init
+    e = child_environ()
     if env:
         e.update(env)
     proc = subprocess.Popen(

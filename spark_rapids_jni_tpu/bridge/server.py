@@ -723,7 +723,8 @@ def main() -> None:
     # (e.g. a TPU-tunnel registration on PYTHONPATH) may force their own
     # platform list, and a second process grabbing the one-tenant TPU tunnel
     # blocks forever.  Tests run the server on CPU for exactly this reason.
-    plat = os.environ.get("JAX_PLATFORMS")
+    from ..utils.config import config
+    plat = config.jax_platforms
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)

@@ -22,7 +22,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from ..columnar import Table
+from ..columnar import Column, Table
 from ..utils import metrics, timeline
 from ..utils.errors import CancelToken, classify
 from ..utils.memory import table_nbytes
@@ -59,6 +59,8 @@ def _eval_expr(expr, table: Table):
     head = expr[0]
     if head == "col":
         c = table.column(expr[1])
+        if c.dtype.is_string:
+            return c, c.validity  # compared via ops.strings.equal below
         vals = c.float_values() if c.dtype.is_floating else c.data
         return vals, c.validity
     if head == "lit":
@@ -70,6 +72,18 @@ def _eval_expr(expr, table: Table):
     b, bvalid = _eval_expr(expr[2], table)
     valid = avalid if bvalid is None else \
         (bvalid if avalid is None else avalid & bvalid)
+    if isinstance(a, Column) or isinstance(b, Column):
+        # STRING operand: chars/offsets need the dedicated equality kernel;
+        # found by the plan-space fuzzer — ("!=", col(<str>), lit(<str>))
+        # previously compared the raw chars buffer against the literal
+        if head not in ("==", "!="):
+            raise ValueError(
+                f"string comparison {head!r} unsupported (only ==/!=; "
+                f"verify() rejects ordering comparisons over strings)")
+        from ..ops import strings as _strings
+        scol, other = (a, b) if isinstance(a, Column) else (b, a)
+        eq = jnp.asarray(_strings.equal(scol, other).data, jnp.bool_)
+        return (eq if head == "==" else jnp.logical_not(eq)), valid
     if head == ">=":
         return a >= b, valid
     if head == "<=":
@@ -295,6 +309,11 @@ def _exec_project(node: Project, memo: dict, stats: dict,
 def _exec_join(node: Join, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
     left = _exec(node.left, memo, stats, ctx)
     right = _exec(node.right, memo, stats, ctx)
+    if node.how == "cross":
+        # keyless by definition (ops.cross_join takes no key lists);
+        # found by the plan-space fuzzer — every Join(how="cross") plan
+        # previously died here on a TypeError
+        return _join_fns()["cross"](left, right)
     return _join_fns()[node.how](left, right, list(node.left_keys),
                                  list(node.right_keys))
 

@@ -1,0 +1,816 @@
+"""Seeded plan-space fuzzer + differential rewrite-soundness harness.
+
+The generative half of the plan-algebra soundness analyzer
+(docs/ANALYSIS.md): before AQE starts rewriting plans mid-query
+(ROADMAP item 1), every optimizer rule gets adversarial coverage over
+random valid plans instead of the handful of shapes the tests and
+benches happen to build.  Four pieces:
+
+1. **Warehouse generator** — a tiny seeded parquet star schema
+   (``gen_warehouse``): one fact table with integer keys of differing
+   cardinality, a string key, quarter-valued float64 measures (every
+   value is ``n/4``, so sums/mins/maxes stay exactly representable and
+   executor parity can be asserted bit-for-bit regardless of reduction
+   order), plus dimension tables keyed by each family.  The dataframes
+   are kept in memory as the oracle's base relations.
+
+2. **Plan generator** — ``gen_plan`` synthesizes a random valid plan
+   over all 9 ``plan._NODE_TYPES``: scans with column subsets,
+   filters over a random operator tree, projects, joins in every key
+   family (int/string) and how (inner/left/semi/anti/cross),
+   aggregates (including order-sensitive ``first``/``last`` over
+   order-deterministic chains), sorts/top-k with a unique tiebreak
+   suffix (so LIMIT cutoffs are deterministic across executors), and
+   occasionally a hand-placed hash Exchange in the two
+   partitioning-sound positions (under an Aggregate on a subset of its
+   group keys, or under a Sort).
+
+3. **Differential harness** — ``run_case`` sweeps one plan across the
+   flag matrix (interpreted / fused / distributed-shuffle /
+   distributed-broadcast via ``SRJT_FUSE``/``SRJT_DIST``/
+   ``SRJT_TOPK``/``SRJT_BROADCAST_ROWS``), asserting after every
+   variant: ``verify()`` passes on the optimized plan, the stamped
+   decision ledger equals ``verify.decision_census`` (for plans
+   without hand-placed structure), the static exchange census equals
+   the executed counter, the static sync budget stays inside
+   ``SYNC_WHITELIST``, engine variants agree bit-exactly, and all
+   agree with a pandas oracle evaluated over the in-memory frames.
+
+4. **Shrinker** — ``shrink`` greedily minimizes a failing plan
+   (replace a node by its child, drop filter conjuncts, drop
+   aggregates, drop sort keys) while the same check keeps failing,
+   yielding the smallest repro to store next to the seed.
+
+Everything is driven by ``numpy.random.default_rng([seed, case])`` —
+the same seed replays the same corpus byte-for-byte, which is what
+lets ci/nightly.sh hand a one-line repro (seed + minimal plan JSON) to
+whoever broke an optimizer rule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..utils.config import config
+from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
+                   Project, Scan, Sort, TopK, col, lit, rebuild, topo_nodes)
+
+#: string pool for the string key family (small cardinality, fixed order)
+_STRINGS = ("ash", "birch", "cedar", "dome", "elm", "fir")
+
+#: low-cardinality columns eligible as group/sort keys, by table
+_LOW_CARD = ("k1", "k2", "sk", "dgrp", "skey")
+
+#: aggregate ops the fuzzer emits (var/std/collect_list excluded: their
+#: results are not bit-comparable across reduction orders / executors)
+_AGG_OPS = ("sum", "count", "count_all", "min", "max", "mean")
+_ORDER_OPS = ("first", "last")
+
+#: ledger kinds that leave structure behind (mirror verify.decision_census)
+_STRUCTURAL_KINDS = frozenset(
+    {"broadcast", "shuffle", "partial_agg", "topk", "order_sensitive_revert"})
+
+
+# -- warehouse ---------------------------------------------------------------
+
+def _quarters(rng, n, lo=-400, hi=400) -> np.ndarray:
+    """float64 values on the 1/4 grid: exactly representable, and their
+    sums stay exact, so cross-executor comparison can demand equality."""
+    return rng.integers(lo, hi, n).astype(np.int64) / 4.0
+
+
+def gen_warehouse(root, rng) -> dict:
+    """Write the seeded star schema under ``root``; returns the catalog
+    ``{name: {"path", "df"}}`` with the oracle's in-memory frames."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(str(root), exist_ok=True)
+    n = int(rng.integers(48, 160))
+    fact = pd.DataFrame({
+        "k1": rng.integers(0, 8, n).astype(np.int64),
+        "k2": rng.integers(0, 5, n).astype(np.int64),
+        "sk": np.array(_STRINGS, dtype=object)[rng.integers(
+            0, len(_STRINGS), n)],
+        "v": _quarters(rng, n),
+        "w": rng.integers(-50, 50, n).astype(np.int32),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+    dk1 = np.arange(8, dtype=np.int64)
+    dimfull = pd.DataFrame({           # covers every k1: left joins stay
+        "dk1": dk1,                    # null-free against it
+        "dv": _quarters(rng, len(dk1)),
+        "dgrp": (dk1 % 3).astype(np.int64),
+    })
+    dk2 = np.sort(rng.choice(5, size=3, replace=False)).astype(np.int64)
+    dimpart = pd.DataFrame({           # covers ~60% of k2: semi/anti have
+        "dk2": dk2,                    # real survivors AND real drops
+        "du": rng.integers(0, 100, len(dk2)).astype(np.int64),
+    })
+    dimstr = pd.DataFrame({            # string key family, full coverage
+        "skey": np.array(_STRINGS, dtype=object),
+        "sv": _quarters(rng, len(_STRINGS)),
+    })
+    cat = {}
+    for name, df in (("fact", fact), ("dimfull", dimfull),
+                     ("dimpart", dimpart), ("dimstr", dimstr)):
+        path = str(root / f"{name}.parquet")
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path,
+                       row_group_size=max(8, len(df) // 4))
+        cat[name] = {"path": path, "df": df}
+    return cat
+
+
+# -- plan generation ---------------------------------------------------------
+
+class _Rel:
+    """Generator state for one relation under construction: the plan
+    node plus the facts later stages need to stay valid — column kinds,
+    a column set whose combination is unique (None once lost), and
+    whether row order is still scan-deterministic (a prerequisite for
+    order-sensitive aggregates to be oracle-comparable)."""
+
+    __slots__ = ("node", "kinds", "unique", "ordered")
+
+    def __init__(self, node, kinds, unique, ordered):
+        self.node = node
+        self.kinds = kinds      # {name: "i64"|"i32"|"f64"|"str"}
+        self.unique = unique    # tuple of column names, or None
+        self.ordered = ordered  # bool
+
+
+#: literal domain per generated column (lo, hi) for numerics; the
+#: generator occasionally draws just outside to produce empty results
+_DOMAINS = {
+    "k1": (0, 8), "k2": (0, 5), "w": (-50, 50), "v": (-100.0, 100.0),
+    "rid": (0, 160), "dk1": (0, 8), "dgrp": (0, 3), "dk2": (0, 5),
+    "du": (0, 100), "dv": (-100.0, 100.0), "sv": (-100.0, 100.0),
+}
+
+
+def _gen_lit(rng, c: str, kind: str):
+    if kind == "str":
+        return str(_STRINGS[int(rng.integers(0, len(_STRINGS)))])
+    lo, hi = _DOMAINS.get(c, (0, 100))
+    span = hi - lo
+    if kind == "f64":
+        return float(int(rng.integers((lo - span // 8) * 4,
+                                      (hi + span // 8) * 4 + 1)) / 4.0)
+    return int(rng.integers(lo - max(1, span // 8),
+                            hi + max(1, span // 8) + 1))
+
+
+def _gen_pred(rng, kinds: dict, depth: int = 0) -> tuple:
+    """Random predicate tree over the current columns."""
+    r = rng.random()
+    if depth < 2 and r < 0.35:
+        op = ("&", "|")[int(rng.integers(0, 2))]
+        return (op, _gen_pred(rng, kinds, depth + 1),
+                _gen_pred(rng, kinds, depth + 1))
+    if depth < 2 and r < 0.45:
+        return ("not", _gen_pred(rng, kinds, depth + 1))
+    cols = sorted(kinds)
+    c = cols[int(rng.integers(0, len(cols)))]
+    kind = kinds[c]
+    if kind == "str":
+        cmp = ("==", "!=")[int(rng.integers(0, 2))]
+    else:
+        cmp = (">=", "<=", ">", "<", "==", "!=")[int(rng.integers(0, 6))]
+    return (cmp, col(c), lit(_gen_lit(rng, c, kind)))
+
+
+#: join specs: key column on the current relation -> (dim table, dim key,
+#: dim column kinds, allowed hows).  dimpart's partial key coverage means
+#: left joins against it would manufacture nulls, so it only offers the
+#: null-free hows.
+_JOINS = {
+    "k1": ("dimfull", "dk1", {"dv": "f64", "dgrp": "i64"},
+           ("inner", "left", "semi", "anti")),
+    "k2": ("dimpart", "dk2", {"du": "i64"}, ("inner", "semi", "anti")),
+    "sk": ("dimstr", "skey", {"sv": "f64"},
+           ("inner", "left", "semi", "anti")),
+}
+
+
+def _stage_filter(rng, rel: _Rel, cat) -> _Rel:
+    rel.node = Filter(rel.node, _gen_pred(rng, rel.kinds))
+    return rel
+
+
+def _stage_project(rng, rel: _Rel, cat) -> _Rel:
+    keep = set(rel.unique or ())
+    rest = [c for c in rel.kinds if c not in keep]
+    for c in rest:
+        if rng.random() < 0.7:
+            keep.add(c)
+    cols = [c for c in rel.kinds if c in keep]  # preserve order
+    if not cols:
+        return rel
+    rel.node = Project(rel.node, tuple(cols))
+    rel.kinds = {c: rel.kinds[c] for c in cols}
+    return rel
+
+
+def _stage_join(rng, rel: _Rel, cat) -> _Rel:
+    # a dim whose payload columns are already present was joined before;
+    # skipping it keeps output names collision-free for the oracle
+    avail = [k for k in _JOINS if k in rel.kinds
+             and not any(c in rel.kinds for c in _JOINS[k][2])]
+    if not avail:
+        return rel
+    key = avail[int(rng.integers(0, len(avail)))]
+    dim, dkey, dkinds, hows = _JOINS[key]
+    how = hows[int(rng.integers(0, len(hows)))]
+    right = Scan(cat[dim]["path"])
+    rel.node = Join(rel.node, right, (key,), (dkey,), how)
+    if how in ("inner", "left"):
+        # dim keys are unique, so multiplicity stays 1 and left-side
+        # uniqueness survives; row order is no longer oracle-comparable
+        rel.kinds = {**rel.kinds, **dkinds}
+        rel.ordered = False
+    return rel
+
+
+def _stage_cross(rng, rel: _Rel, cat) -> _Rel:
+    # cross joins only against the 3-row dimpart, to bound blowup
+    if "du" in rel.kinds:
+        return rel
+    rel.node = Join(rel.node, Scan(cat["dimpart"]["path"]), (), (), "cross")
+    rel.kinds = {**rel.kinds, "dk2": "i64", "du": "i64"}
+    u = rel.unique
+    rel.unique = tuple(u) + ("dk2",) if u else None
+    rel.ordered = False
+    return rel
+
+
+def _stage_aggregate(rng, rel: _Rel, cat) -> _Rel:
+    keycand = [c for c in rel.kinds if c in _LOW_CARD]
+    if not keycand:
+        return rel
+    nk = int(rng.integers(1, min(2, len(keycand)) + 1))
+    keys = sorted(rng.choice(keycand, size=nk, replace=False).tolist())
+    numeric = [c for c in rel.kinds
+               if rel.kinds[c] != "str" and c not in keys]
+    ops = list(_AGG_OPS)
+    if rel.ordered and rng.random() < 0.35:
+        ops += list(_ORDER_OPS)
+    aggs, names, kinds = [], [], {k: rel.kinds[k] for k in keys}
+    has_order = False
+    for i in range(int(rng.integers(1, 4))):
+        op = ops[int(rng.integers(0, len(ops)))]
+        if op == "count_all":
+            aggs.append((None, op))
+        else:
+            if not numeric:
+                continue
+            c = numeric[int(rng.integers(0, len(numeric)))]
+            aggs.append((c, op))
+        nm = f"a{i}"
+        names.append(nm)
+        has_order = has_order or op in _ORDER_OPS
+        if op in ("count", "count_all"):
+            kinds[nm] = "i64"
+        elif op == "mean":
+            kinds[nm] = "f64"
+        elif op == "sum":
+            kinds[nm] = "f64" if rel.kinds.get(aggs[-1][0]) == "f64" \
+                else "i64"
+        else:
+            kinds[nm] = rel.kinds.get(aggs[-1][0], "i64")
+    if not aggs:
+        aggs, names = [(None, "count_all")], ["a0"]
+        kinds["a0"] = "i64"
+    child = rel.node
+    manual = False
+    if not has_order and rng.random() < 0.18:
+        # partitioning-sound hand-placed shuffle: hash keys must be a
+        # subset of the group keys (verify.check_partitioning)
+        nx = int(rng.integers(1, len(keys) + 1))
+        xkeys = sorted(rng.choice(keys, size=nx, replace=False).tolist())
+        child = Exchange(child, tuple(xkeys), "hash")
+        manual = True
+    rel.node = Aggregate(child, tuple(keys), tuple(aggs), tuple(names))
+    rel.kinds = kinds
+    rel.unique = tuple(keys)
+    rel.ordered = False
+    if manual:
+        object.__setattr__(rel.node, "_fuzz_manual_exchange", True)
+    return rel
+
+
+def _sort_keys(rng, rel: _Rel) -> tuple:
+    """Random sort keys with the unique-combination suffix appended, so
+    any LIMIT cutoff above is a total order (deterministic across
+    executors and the oracle)."""
+    cols = sorted(rel.kinds)
+    n = int(rng.integers(1, min(2, len(cols)) + 1))
+    picked = rng.choice(cols, size=n, replace=False).tolist()
+    keys = [(c, bool(rng.integers(0, 2))) for c in picked]
+    for u in rel.unique or ():
+        if u not in picked:
+            keys.append((u, True))
+    return tuple(keys)
+
+
+def _stage_order(rng, rel: _Rel, cat) -> _Rel:
+    """Terminal ordering stage: Sort, Limit(Sort) (the fuse_topk shape),
+    a direct TopK, or a Sort over a hand-placed hash exchange."""
+    if rel.unique is None:
+        return rel
+    keys = _sort_keys(rng, rel)
+    r = rng.random()
+    if r < 0.30:
+        rel.node = Sort(rel.node, keys)
+    elif r < 0.55:
+        rel.node = Limit(Sort(rel.node, keys), int(rng.integers(1, 24)))
+    elif r < 0.75:
+        rel.node = TopK(rel.node, keys, int(rng.integers(1, 24)))
+    elif r < 0.85:
+        inner = Exchange(rel.node, (keys[0][0],), "hash")
+        object.__setattr__(inner, "_fuzz_manual_exchange", True)
+        rel.node = Sort(inner, keys)
+    rel.ordered = True
+    return rel
+
+
+def gen_plan(rng, cat) -> PlanNode:
+    """One random valid plan over the catalog (all 9 node types
+    reachable).  Same rng state -> same plan, always."""
+    kinds = {"k1": "i64", "k2": "i64", "sk": "str", "v": "f64",
+             "w": "i32", "rid": "i64"}
+    scan_cols = None
+    if rng.random() < 0.3:
+        drop = ("v", "w")[int(rng.integers(0, 2))]
+        scan_cols = tuple(c for c in kinds if c != drop)
+        kinds = {c: kinds[c] for c in scan_cols}
+    rel = _Rel(Scan(cat["fact"]["path"], columns=scan_cols),
+               kinds, ("rid",), True)
+    stages = (_stage_filter, _stage_join, _stage_project, _stage_cross)
+    weights = (0.42, 0.30, 0.18, 0.10)
+    for _ in range(int(rng.integers(1, 5))):
+        rel = rng.choice(stages, p=weights)(rng, rel, cat)
+    if rng.random() < 0.55:
+        rel = _stage_aggregate(rng, rel, cat)
+        if rng.random() < 0.35:
+            rel = _stage_filter(rng, rel, cat)
+    return _stage_order(rng, rel, cat).node
+
+
+def has_manual_structure(plan: PlanNode) -> bool:
+    """True when the UNOPTIMIZED plan carries hand-placed Exchange or
+    TopK nodes — shapes whose structure predates the planner, so the
+    ledger==census invariant (which models planner-made structure only)
+    does not apply."""
+    return any(isinstance(n, (Exchange, TopK)) for n in topo_nodes(plan))
+
+
+# -- pandas oracle -----------------------------------------------------------
+
+_PD_CMP = {">=": "__ge__", "<=": "__le__", ">": "__gt__", "<": "__lt__",
+           "==": "__eq__", "!=": "__ne__"}
+
+
+def _eval_pd(expr, df):
+    head = expr[0]
+    if head == "col":
+        return df[expr[1]]
+    if head == "lit":
+        return expr[1]
+    if head == "not":
+        return ~_eval_pd(expr[1], df)
+    a, b = _eval_pd(expr[1], df), _eval_pd(expr[2], df)
+    if head == "&":
+        return a & b
+    if head == "|":
+        return a | b
+    return getattr(a, _PD_CMP[head])(b)
+
+
+def _oracle_scan(node: Scan, env):
+    df = env[str(node.path)]
+    if node.columns is not None:
+        df = df[list(node.columns)]
+    return df.copy()  # scan.predicate only prunes row groups
+
+
+def _oracle_filter(node: Filter, env):
+    df = _oracle(node.child, env)
+    mask = _eval_pd(node.predicate, df)
+    return df[np.asarray(mask, dtype=bool)]
+
+
+def _oracle_project(node: Project, env):
+    return _oracle(node.child, env)[list(node.columns)]
+
+
+def _oracle_join(node: Join, env):
+    left = _oracle(node.left, env)
+    right = _oracle(node.right, env)
+    lk, rk = list(node.left_keys), list(node.right_keys)
+    if node.how in ("semi", "anti"):
+        hit = left.merge(right[rk].drop_duplicates(), left_on=lk,
+                         right_on=rk, how="inner")
+        key = left[lk].apply(tuple, axis=1) if len(lk) > 1 else left[lk[0]]
+        seen = set(hit[lk].apply(tuple, axis=1)) if len(lk) > 1 \
+            else set(hit[lk[0]])
+        mask = key.isin(seen)
+        return left[mask if node.how == "semi" else ~mask]
+    if node.how == "cross":
+        out = left.merge(right, how="cross")
+    else:
+        out = left.merge(right, left_on=lk, right_on=rk, how=node.how,
+                         suffixes=("", "_r"))
+    drop = [k for k in rk if k not in left.columns]
+    return out.drop(columns=drop)
+
+
+_PD_AGG = {"sum": "sum", "min": "min", "max": "max", "mean": "mean",
+           "count": "count", "first": "first", "last": "last"}
+
+
+def _oracle_aggregate(node: Aggregate, env):
+    import pandas as pd
+    df = _oracle(node.child, env)
+    g = df.groupby(list(node.keys), sort=False, dropna=False)
+    pieces = {}
+    for (cname, op), outname in zip(node.aggs, node.names):
+        if op == "count_all":
+            pieces[outname] = g.size()
+        else:
+            pieces[outname] = g[cname].agg(_PD_AGG[op])
+    out = pd.DataFrame(pieces).reset_index()
+    return out[list(node.keys) + list(node.names)]
+
+
+def _oracle_sort(node: Sort, env):
+    df = _oracle(node.child, env)
+    return df.sort_values([c for c, _ in node.keys],
+                          ascending=[a for _, a in node.keys],
+                          kind="mergesort")
+
+
+def _oracle_limit(node: Limit, env):
+    return _oracle(node.child, env).head(node.n)
+
+
+def _oracle_topk(node: TopK, env):
+    df = _oracle(node.child, env)
+    return df.sort_values([c for c, _ in node.keys],
+                          ascending=[a for _, a in node.keys],
+                          kind="mergesort").head(node.n)
+
+
+def _oracle_exchange(node: Exchange, env):
+    return _oracle(node.child, env)  # repartitioning preserves the multiset
+
+
+#: plan-node class -> reference semantics; tools/srjt_lint.py asserts
+#: this stays exhaustive over plan._NODE_TYPES, like verify._INFER
+_ORACLE = {
+    Scan: _oracle_scan,
+    Filter: _oracle_filter,
+    Project: _oracle_project,
+    Join: _oracle_join,
+    Aggregate: _oracle_aggregate,
+    Sort: _oracle_sort,
+    Limit: _oracle_limit,
+    TopK: _oracle_topk,
+    Exchange: _oracle_exchange,
+}
+
+
+def _oracle(node: PlanNode, env):
+    fn = _ORACLE.get(type(node))
+    if fn is None:
+        raise TypeError(f"no oracle rule for {type(node).__name__} "
+                        f"(register it in fuzz._ORACLE)")
+    return fn(node, env)
+
+
+def oracle(plan: PlanNode, cat) -> "object":
+    """Reference result of the UNOPTIMIZED plan over the in-memory
+    frames, as a pandas DataFrame."""
+    env = {e["path"]: e["df"] for e in cat.values()}
+    return _oracle(plan, env).reset_index(drop=True)
+
+
+# -- differential harness ----------------------------------------------------
+
+#: the flag matrix: every generated plan runs under each of these;
+#: broadcast_rows=0 forces shuffle joins, the huge threshold forces
+#: broadcast, so both distributed join strategies are exercised per plan
+VARIANTS = (
+    {"name": "interp", "fuse": False, "distribute": False},
+    {"name": "fused", "fuse": True, "distribute": False},
+    {"name": "dist-shuffle", "fuse": True, "distribute": True,
+     "broadcast_rows": 0},
+    {"name": "dist-broadcast", "fuse": True, "distribute": True,
+     "broadcast_rows": 1_000_000},
+)
+
+#: extra variants the nightly sweep adds on top of VARIANTS
+FULL_VARIANTS = VARIANTS + (
+    {"name": "dist-nofuse", "fuse": False, "distribute": True,
+     "broadcast_rows": 0},
+    {"name": "interp-notopk", "fuse": False, "distribute": False,
+     "topk": False},
+)
+
+
+@contextlib.contextmanager
+def _flags(**kw):
+    """Temporarily set config fields (the sweep axis).  Field mutation,
+    not env vars: the flag matrix must not leak into child state."""
+    saved = {k: getattr(config, k) for k in kw}
+    try:
+        for k, v in kw.items():
+            setattr(config, k, v)
+        yield
+    finally:
+        for k, v in saved.items():
+            setattr(config, k, v)
+
+
+class SoundnessFailure(Exception):
+    """One differential-harness check failed for one (plan, variant)."""
+
+    def __init__(self, check: str, variant: str, message: str):
+        self.check = check
+        self.variant = variant
+        super().__init__(f"[{check}] under {variant}: {message}")
+
+
+def _as_frame(table):
+    import pandas as pd
+    names = table.names or [f"c{i}" for i in range(table.num_columns)]
+    cols = {}
+    for n, c in zip(names, table.columns):
+        if c.dtype.is_string:
+            cols[n] = np.array(c.to_pylist(), dtype=object)
+        else:
+            cols[n] = np.asarray(c.to_numpy())
+    return pd.DataFrame(cols)
+
+
+def _canonical(df):
+    """Row-multiset canonical form: stable-sorted by every column."""
+    if not len(df.columns):
+        return df.reset_index(drop=True)
+    return df.sort_values(list(df.columns),
+                          kind="mergesort").reset_index(drop=True)
+
+
+def _frames_match(a, b, exact: bool) -> Optional[str]:
+    """None when equal as row multisets (same column order), else a
+    short description of the first difference."""
+    import pandas as pd
+    if list(a.columns) != list(b.columns):
+        return f"column order {list(a.columns)} != {list(b.columns)}"
+    if len(a) != len(b):
+        return f"row count {len(a)} != {len(b)}"
+    ca, cb = _canonical(a), _canonical(b)
+    kw = {"check_exact": True} if exact \
+        else {"check_exact": False, "rtol": 1e-9, "atol": 1e-9}
+    try:
+        pd.testing.assert_frame_equal(ca, cb, check_dtype=False, **kw)
+    except AssertionError as e:
+        return str(e).split("\n")[0][:200]
+    return None
+
+
+def _check_ledger(opt, dist: bool) -> Optional[str]:
+    """Structural ledger entries must equal decision_census, kind for
+    kind and path for path (the PR 12 invariant, now fuzzed)."""
+    from .verify import decision_census
+    led = sorted((d["kind"], d.get("path"))
+                 for d in getattr(opt, "_decisions", ())
+                 if d["kind"] in _STRUCTURAL_KINDS)
+    cen = sorted((c["kind"], c["path"])
+                 for c in decision_census(opt, dist=dist))
+    if led != cen:
+        return f"ledger {led} != census {cen}"
+    return None
+
+
+def run_case(plan: PlanNode, cat, variants=VARIANTS,
+             optimize_fn: Optional[Callable] = None) -> None:
+    """Run one plan through the full differential matrix; raises
+    :class:`SoundnessFailure` on the first violated invariant.
+
+    ``optimize_fn`` overrides ``optimizer.optimize`` — the
+    broken-rule-injection tests pass a sabotaged pipeline here and
+    assert the harness catches it.
+    """
+    from . import optimizer
+    from .executor import execute, new_stats
+    from .verify import (SYNC_WHITELIST, plan_exchanges, sync_budget,
+                         verify)
+    opt_fn = optimize_fn or optimizer.optimize
+    manual = has_manual_structure(plan)
+    ref = oracle(plan, cat)
+    results = []
+    for v in variants:
+        name = v["name"]
+        flags = {k: val for k, val in v.items() if k != "name"}
+        dist = bool(flags.get("distribute", False))
+        with _flags(verify=True, **flags):
+            try:
+                opt = opt_fn(plan, distribute=dist)
+            except Exception as e:
+                raise SoundnessFailure("optimize", name, repr(e)[:300])
+            try:
+                verify(opt)
+            except Exception as e:
+                raise SoundnessFailure("verify-after-rewrite", name,
+                                       repr(e)[:300])
+            if not manual:
+                bad = _check_ledger(opt, dist)
+                if bad:
+                    raise SoundnessFailure("ledger-census", name, bad)
+            for e in sync_budget(opt, cfg=config):
+                if e["count"] and e["site"] not in SYNC_WHITELIST:
+                    raise SoundnessFailure(
+                        "sync-whitelist", name,
+                        f"unwhitelisted sync {e['site']} at {e['path']}")
+            stats = new_stats()
+            try:
+                tbl = execute(opt, stats)
+            except Exception as e:
+                raise SoundnessFailure("execute", name, repr(e)[:300])
+            static_ex = len(plan_exchanges(opt))
+            if stats["exchanges"] != static_ex:
+                raise SoundnessFailure(
+                    "exchange-census", name,
+                    f"static census {static_ex} != executed "
+                    f"{stats['exchanges']}")
+            results.append((name, _as_frame(tbl)))
+    base_name, base = results[0]
+    for name, frame in results[1:]:
+        bad = _frames_match(base, frame, exact=True)
+        if bad:
+            raise SoundnessFailure("executor-parity", name,
+                                   f"{name} != {base_name}: {bad}")
+    bad = _frames_match(base, ref, exact=False)
+    if bad:
+        raise SoundnessFailure("oracle-parity", base_name,
+                               f"engine != pandas oracle: {bad}")
+
+
+# -- shrinker ----------------------------------------------------------------
+
+def _replace(root: PlanNode, target: PlanNode,
+             sub: PlanNode) -> PlanNode:
+    """New tree with ``target`` (by identity) swapped for ``sub``."""
+    if root is target:
+        return sub
+    changes = {}
+    for f in ("child", "left", "right"):
+        c = getattr(root, f, None)
+        if isinstance(c, PlanNode):
+            r = _replace(c, target, sub)
+            if r is not c:
+                changes[f] = r
+    return rebuild(root, **changes) if changes else root
+
+
+def _conjuncts(expr) -> list:
+    if expr[0] == "&":
+        return _conjuncts(expr[1]) + _conjuncts(expr[2])
+    return [expr]
+
+
+def _candidates(plan: PlanNode):
+    """Structurally smaller variants of ``plan``, coarsest first."""
+    for n in topo_nodes(plan):
+        child = getattr(n, "child", None)
+        if isinstance(child, PlanNode):
+            yield _replace(plan, n, child)
+        if isinstance(n, Join):
+            yield _replace(plan, n, n.left)
+    for n in topo_nodes(plan):
+        if isinstance(n, Filter):
+            parts = _conjuncts(n.predicate)
+            if len(parts) > 1:
+                for i in range(len(parts)):
+                    kept = parts[:i] + parts[i + 1:]
+                    pred = kept[0]
+                    for p in kept[1:]:
+                        pred = ("&", pred, p)
+                    yield _replace(plan, n, Filter(n.child, pred))
+        elif isinstance(n, Aggregate) and len(n.aggs) > 1:
+            for i in range(len(n.aggs)):
+                yield _replace(
+                    plan, n,
+                    Aggregate(n.child, n.keys,
+                              n.aggs[:i] + n.aggs[i + 1:],
+                              n.names[:i] + n.names[i + 1:]))
+        elif isinstance(n, (Sort, TopK)) and len(n.keys) > 1:
+            for i in range(len(n.keys)):
+                yield _replace(plan, n,
+                               rebuild(n, keys=n.keys[:i] + n.keys[i + 1:]))
+
+
+def shrink(plan: PlanNode, fails: Callable) -> PlanNode:
+    """Greedy fixpoint minimization: adopt any structurally smaller
+    candidate for which ``fails(candidate)`` still returns truthy (the
+    caller pins "same check code" inside ``fails``), until no candidate
+    improves.  ``fails`` must treat an INVALID candidate (verify error
+    on the unoptimized plan, oracle crash) as not-failing, so the
+    shrinker never walks out of the valid-plan space."""
+    cur = plan
+    improved = True
+    while improved:
+        improved = False
+        for cand in _candidates(cur):
+            if cand is None or cand is cur:
+                continue
+            if len(topo_nodes(cand)) >= len(topo_nodes(cur)):
+                continue
+            try:
+                if fails(cand):
+                    cur = cand
+                    improved = True
+                    break
+            except Exception:
+                continue  # candidate invalid or check crashed: skip
+    return cur
+
+
+# -- corpus driver -----------------------------------------------------------
+
+def same_check_fails(cat, check: str, variants=VARIANTS) -> Callable:
+    """A ``fails`` predicate for :func:`shrink`: candidate must be a
+    valid plan AND reproduce the same failing check code."""
+    from .verify import verify
+
+    def _fails(cand: PlanNode) -> bool:
+        try:
+            verify(cand)
+            oracle(cand, cat)
+        except Exception:
+            return False  # invalid candidate, not a repro
+        try:
+            run_case(cand, cat, variants)
+        except SoundnessFailure as e:
+            return e.check == check
+        return False
+
+    return _fails
+
+
+def run_corpus(seed: int, count: int, root, variants=VARIANTS,
+               optimize_fn: Optional[Callable] = None,
+               log: Optional[Callable] = None,
+               shrink_failures: bool = True) -> dict:
+    """The fuzzing loop: one seeded warehouse, ``count`` generated
+    plans, each swept through the variant matrix.  Returns
+    ``{"seed", "cases", "failures": [...]}`` where each failure carries
+    the case index, the check, the message, and the SHRUNK minimal plan
+    as canonical JSON — exactly what ci/nightly.sh persists as the
+    repro artifact."""
+    wrng = np.random.default_rng([seed, 0])
+    cat = gen_warehouse(root, wrng)
+    failures = []
+    for i in range(count):
+        rng = np.random.default_rng([seed, i + 1])
+        plan = gen_plan(rng, cat)
+        try:
+            run_case(plan, cat, variants, optimize_fn=optimize_fn)
+        except SoundnessFailure as e:
+            minimal = plan
+            if shrink_failures and optimize_fn is None:
+                minimal = shrink(plan, same_check_fails(cat, e.check,
+                                                        variants))
+            elif shrink_failures:
+                # injected-rule runs shrink against the same sabotaged
+                # pipeline, not the stock optimizer
+                def _fails(cand, _check=e.check):
+                    try:
+                        run_case(cand, cat, variants,
+                                 optimize_fn=optimize_fn)
+                    except SoundnessFailure as se:
+                        return se.check == _check
+                    return False
+                minimal = shrink(plan, _fails)
+            failures.append({
+                "seed": seed, "case": i, "check": e.check,
+                "variant": e.variant, "message": str(e),
+                "plan_nodes": len(topo_nodes(plan)),
+                "minimal_nodes": len(topo_nodes(minimal)),
+                "minimal_plan": json.loads(
+                    minimal.serialize().decode("utf-8")),
+            })
+            if log:
+                log(f"case {i}: FAIL {e.check} "
+                    f"({len(topo_nodes(plan))} -> "
+                    f"{len(topo_nodes(minimal))} nodes)")
+        else:
+            if log and (i + 1) % 10 == 0:
+                log(f"case {i + 1}/{count}: ok")
+    return {"seed": seed, "cases": count, "failures": failures}

@@ -434,6 +434,7 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
     """
     if id(node) in memo:
         return memo[id(node)]
+    mark = len(dec)  # this subtree's ledger entries start here
     kids = {f: _plan_exchanges(getattr(node, f), pmemo, est, memo, dec)
             for f in ("child", "left", "right") if hasattr(node, f)}
     out = rebuild(node, **{k: v for k, v in kids.items()
@@ -483,8 +484,13 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
             # which _exec_exchange's hash kind deliberately does not
             # preserve (order-insensitive consumers only) — revert to the
             # pre-pass subtree so no planner-placed exchange can silently
-            # reorder rows anywhere below this aggregate
+            # reorder rows anywhere below this aggregate.  The subtree's
+            # own ledger entries revert with it: the structures they
+            # describe no longer exist in the surviving plan (found by
+            # the plan-space fuzzer: ledger != decision_census for an
+            # order-sensitive aggregate above a planned join)
             out = node
+            del dec[mark:]
             dec.append({"kind": "order_sensitive_revert",
                         "keys": list(node.keys),
                         "aggs": sorted({op for _, op in node.aggs

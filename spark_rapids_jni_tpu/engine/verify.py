@@ -40,10 +40,12 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from ..dtypes import BOOL8, FLOAT64, INT64, LIST, DType
-from .plan import (Aggregate, Exchange, Filter, Join, Limit, PlanNode,
-                   Project, Scan, Sort, TopK, co_partitioned, node_label,
-                   partitioning, topo_nodes)
+from .plan import (ORDER_SENSITIVE_AGGS, Aggregate, Exchange, Filter, Join,
+                   Limit, PlanNode, Project, Scan, Sort, TopK, co_partitioned,
+                   expr_columns, node_label, partitioning, topo_nodes)
 
 #: the deliberate host-sync sites the engine is allowed to pay
 #: (metrics.host_sync labels; the AST lint in tools/srjt_lint.py rejects
@@ -65,15 +67,29 @@ _FORBIDDEN_PRIMITIVES = frozenset({
 #: aggregate ops that require a numeric (or decimal) input column
 _NUMERIC_AGGS = frozenset({"sum", "mean", "var", "std", "sumsq", "fsum"})
 
+#: the two-point nullability lattice flowing through the abstract
+#: interpreter: ``"never"`` (proven non-null by footer stats or a filter
+#: over the column) ⊑ ``"maybe"`` (top — anything unproven).  A rewrite
+#: moving a root column between the two is ``rewrite-nullability-change``.
+NULL_NEVER = "never"
+NULL_MAYBE = "maybe"
+
+#: past ±2^53 a float64 can no longer represent every integer, so a
+#: comparison that promotes an integral column (or integral literal) into
+#: the float domain silently collapses neighbouring values
+_FLOAT64_EXACT_INT = 2 ** 53
+
 
 class PlanVerificationError(ValueError):
     """A plan failed a build-time check.
 
     Structured so the bridge can ship it as a machine-parseable error
     reply: ``code`` names the check (``unknown-column``,
-    ``join-key-dtype-mismatch``, ``invalid-cast``, ``aggregate-over-string``,
-    ``rewrite-schema-change``, ``unknown-node``), ``node_path`` locates the
-    offending node from the root (``root.child.left`` ...).
+    ``join-key-dtype-mismatch``, ``invalid-cast``, ``overflow-unsafe-cast``,
+    ``aggregate-over-string``, ``order-sensitive-exchange``,
+    ``rewrite-schema-change``, ``rewrite-nullability-change``,
+    ``unknown-node``), ``node_path`` locates the offending node from the
+    root (``root.child.left`` ...).
     """
 
     def __init__(self, code: str, node_path: str, message: str):
@@ -103,6 +119,41 @@ class SchemaResolver:
 
     def __init__(self):
         self._files: dict = {}
+        self._nulls: dict = {}
+
+    def file_nullability(self, node: Scan) -> Optional[dict]:
+        """Footer-derived nullability facts: ``{name: "never"|"maybe"}``.
+
+        A parquet column whose every row group carries statistics with a
+        zero null count is proven ``"never"`` null; a missing stats block,
+        an unknown null count, or a non-parquet source degrades to
+        ``"maybe"`` (the lattice top).  Unreadable files resolve to
+        ``None``, exactly like :meth:`file_schema`.
+        """
+        key = (node.format, node.path)
+        if key not in self._nulls:
+            try:
+                if node.format == "parquet":
+                    from ..io import ParquetFile
+                    pf = ParquetFile(node.path)
+                    out = {}
+                    for c in pf.schema:
+                        never = pf.num_row_groups > 0
+                        for gi in range(pf.num_row_groups):
+                            st = pf.group_stats(gi, c.name)
+                            if st is None or st[2] is None or st[2] > 0:
+                                never = False
+                                break
+                        out[c.name] = NULL_NEVER if never else NULL_MAYBE
+                    self._nulls[key] = out
+                else:
+                    from ..io import ORCFile
+                    self._nulls[key] = {nm: NULL_MAYBE for nm, _dt
+                                        in ORCFile(node.path).schema}
+            except Exception:
+                self._nulls[key] = None
+        nl = self._nulls[key]
+        return None if nl is None else dict(nl)
 
     def file_schema(self, node: Scan) -> Optional[dict]:
         key = (node.format, node.path)
@@ -226,17 +277,58 @@ def _expr_dtype(expr, schema: dict, path: str,
             "invalid-cast", path,
             f"{node_label(node)}: comparison {head!r} between {a!r} and "
             f"{b!r} — string vs non-string needs an explicit cast")
+    if "string" in (fa, fb) and head not in ("==", "!="):
+        raise PlanVerificationError(
+            "invalid-cast", path,
+            f"{node_label(node)}: ordering comparison {head!r} over STRING "
+            f"operands — the string kernel set defines only ==/!=")
+    for lit_side, dt_side in ((expr[1], b), (expr[2], a)):
+        if lit_side[0] == "lit":
+            _check_lit_overflow(head, dt_side, lit_side[1], path, node)
     return BOOL8
+
+
+def _check_lit_overflow(head, col_dt: Optional[DType], value, path: str,
+                        node: PlanNode) -> None:
+    """Cast/overflow legality of one ``col <op> lit`` comparison: the
+    executor lowers both sides into the column's jnp domain, so a literal
+    the domain cannot represent exactly makes the comparison silently
+    wrong instead of merely slow (``overflow-unsafe-cast``)."""
+    if col_dt is None or isinstance(value, bool):
+        return
+    if col_dt.is_integral and isinstance(value, int):
+        info = np.iinfo(col_dt.storage)
+        if not (int(info.min) <= value <= int(info.max)):
+            raise PlanVerificationError(
+                "overflow-unsafe-cast", path,
+                f"{node_label(node)}: literal {value} overflows the "
+                f"{col_dt!r} column domain [{info.min}, {info.max}] in "
+                f"comparison {head!r}")
+    elif col_dt.is_integral and isinstance(value, float):
+        if abs(value) > _FLOAT64_EXACT_INT:
+            raise PlanVerificationError(
+                "overflow-unsafe-cast", path,
+                f"{node_label(node)}: float literal {value!r} promotes the "
+                f"{col_dt!r} column to float64 beyond the 2^53 exact-integer "
+                f"range in comparison {head!r}")
+    elif col_dt.is_floating and isinstance(value, int):
+        if abs(value) > _FLOAT64_EXACT_INT:
+            raise PlanVerificationError(
+                "overflow-unsafe-cast", path,
+                f"{node_label(node)}: integer literal {value} is not exactly "
+                f"representable as {col_dt!r} (past 2^53) in comparison "
+                f"{head!r}")
 
 
 # -- per-node infer_schema rules (the verifier dispatch table) --------------
 
 class _Ctx:
-    __slots__ = ("resolver", "memo")
+    __slots__ = ("resolver", "memo", "nmemo")
 
     def __init__(self, resolver: SchemaResolver):
         self.resolver = resolver
         self.memo: dict = {}
+        self.nmemo: dict = {}
 
 
 def _infer_scan(node: Scan, path: str, ctx: _Ctx) -> Optional[dict]:
@@ -332,6 +424,17 @@ def _infer_join(node: Join, path: str, ctx: _Ctx) -> Optional[dict]:
 
 
 def _infer_aggregate(node: Aggregate, path: str, ctx: _Ctx) -> Optional[dict]:
+    if any(op in ORDER_SENSITIVE_AGGS for _c, op in node.aggs):
+        below = node.child
+        while isinstance(below, (Filter, Project, Limit)):
+            below = below.child  # order-preserving unaries
+        if isinstance(below, Exchange) and below.kind == "hash":
+            raise PlanVerificationError(
+                "order-sensitive-exchange", path,
+                f"order-sensitive aggregate "
+                f"({[op for _c, op in node.aggs if op in ORDER_SENSITIVE_AGGS]}) "
+                f"fed by a hash exchange: the shuffle destroys the row order "
+                f"first/last/collect_list depend on")
     child = _infer(node.child, path + ".child", ctx)
     if child is None:
         return None
@@ -440,28 +543,157 @@ def verify(plan: PlanNode,
     return _infer(plan, "root", _Ctx(resolver or SchemaResolver()))
 
 
+# -- nullability abstract interpretation ------------------------------------
+
+def _nulls_scan(node: Scan, path: str, ctx: _Ctx) -> Optional[dict]:
+    nl = ctx.resolver.file_nullability(node)
+    if nl is None:
+        return None
+    if node.columns is not None:
+        return {c: nl.get(c, NULL_MAYBE) for c in node.columns}
+    return nl
+
+
+def _nulls_filter(node: Filter, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _nulls(node.child, path + ".child", ctx)
+    if child is None:
+        return None
+    # the executor ANDs the validity of EVERY predicate-referenced column
+    # into the keep-mask (engine/executor._eval_expr), so survivors are
+    # proven non-null in those columns regardless of the operator tree
+    out = dict(child)
+    for c in expr_columns(node.predicate):
+        if c in out:
+            out[c] = NULL_NEVER
+    return out
+
+
+def _nulls_project(node: Project, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _nulls(node.child, path + ".child", ctx)
+    if child is None:
+        return None
+    return {c: child[c] for c in node.columns if c in child}
+
+
+def _nulls_join(node: Join, path: str, ctx: _Ctx) -> Optional[dict]:
+    left = _nulls(node.left, path + ".left", ctx)
+    right = _nulls(node.right, path + ".right", ctx)
+    if node.how in ("semi", "anti"):
+        return left
+    if left is None or right is None:
+        return None
+    # outer joins pad the unmatched side with nulls, widening every one of
+    # its columns to "maybe" — the precise fact the lattice exists to track
+    if node.how in ("left", "full"):
+        right = {c: NULL_MAYBE for c in right}
+    if node.how in ("right", "full"):
+        left = {c: NULL_MAYBE for c in left}
+    rkeys = set(node.right_keys) if node.how != "cross" else set()
+    out = dict(left)
+    for nm, nu in right.items():
+        if nm in rkeys:
+            continue
+        out[nm + ("_r" if nm in left else "")] = nu
+    return out
+
+
+def _nulls_aggregate(node: Aggregate, path: str, ctx: _Ctx) -> Optional[dict]:
+    child = _nulls(node.child, path + ".child", ctx)
+    if child is None:
+        return None
+    out = {k: child.get(k, NULL_MAYBE) for k in node.keys}
+    for (cname, op), outname in zip(node.aggs, node.names):
+        if op in ("count", "count_all") or op == "collect_list":
+            out[outname] = NULL_NEVER  # counts and lists always materialize
+        elif cname is None:
+            out[outname] = NULL_NEVER
+        else:
+            out[outname] = child.get(cname, NULL_MAYBE)
+    return out
+
+
+def _nulls_child(node, path: str, ctx: _Ctx) -> Optional[dict]:
+    """Sort/Limit/TopK/Exchange: row-set reshapes, nullability-transparent."""
+    return _nulls(node.child, path + ".child", ctx)
+
+
+#: plan-node class -> nullability rule; tools/srjt_lint.py asserts this
+#: stays exhaustive over plan._NODE_TYPES, like _INFER
+_NULLS = {
+    Scan: _nulls_scan,
+    Filter: _nulls_filter,
+    Project: _nulls_project,
+    Join: _nulls_join,
+    Aggregate: _nulls_aggregate,
+    Sort: _nulls_child,
+    Limit: _nulls_child,
+    TopK: _nulls_child,
+    Exchange: _nulls_child,
+}
+
+
+def _nulls(node: PlanNode, path: str, ctx: _Ctx) -> Optional[dict]:
+    if id(node) in ctx.nmemo:
+        return ctx.nmemo[id(node)]
+    fn = _NULLS.get(type(node))
+    if fn is None:
+        raise PlanVerificationError(
+            "unknown-node", path,
+            f"plan node {type(node).__name__} has no nullability rule "
+            f"(register it in verify._NULLS)")
+    out = fn(node, path, ctx)
+    ctx.nmemo[id(node)] = out
+    return out
+
+
+def infer_nullability(plan: PlanNode,
+                      resolver: Optional[SchemaResolver] = None
+                      ) -> Optional[dict]:
+    """Abstract interpretation over the nullability lattice: the root's
+    ``{name: "never"|"maybe"}``, or ``None`` when no scan footer resolved.
+
+    Companion pass to :func:`verify` — where ``verify`` proves dtype
+    shape, this proves null behaviour, so :class:`RewriteChecker` can
+    reject a rewrite that silently turns a proven-non-null column nullable
+    (or claims the reverse) even though the dtypes still line up.
+    """
+    return _nulls(plan, "root", _Ctx(resolver or SchemaResolver()))
+
+
 class RewriteChecker:
-    """Asserts optimizer rewrites preserve the root output schema.
+    """Asserts optimizer rewrites preserve the root output schema AND the
+    root nullability vector.
 
     Built on the ORIGINAL plan (which also runs the build-time checks up
     front); ``check(rule, plan)`` re-verifies after each rule and raises
-    ``rewrite-schema-change`` if the root schema moved — an optimizer bug
-    caught at plan time instead of a silently wrong result.
+    ``rewrite-schema-change`` if the root schema moved, or
+    ``rewrite-nullability-change`` if a root column's position in the
+    nullability lattice moved — an optimizer bug caught at plan time
+    instead of a silently wrong result.
     """
 
     def __init__(self, plan: PlanNode):
         self.resolver = SchemaResolver()
         self.base = verify(plan, self.resolver)
+        self.base_nulls = infer_nullability(plan, self.resolver)
 
     def check(self, rule: str, plan: PlanNode) -> None:
         after = verify(plan, self.resolver)
-        if self.base is None or after is None:
-            return  # unresolvable scans: nothing to compare
-        if list(self.base.items()) != list(after.items()):
-            raise PlanVerificationError(
-                "rewrite-schema-change", "root",
-                f"optimizer rule {rule!r} changed the root schema from "
-                f"{list(self.base)} to {list(after)}")
+        if self.base is not None and after is not None:
+            if list(self.base.items()) != list(after.items()):
+                raise PlanVerificationError(
+                    "rewrite-schema-change", "root",
+                    f"optimizer rule {rule!r} changed the root schema from "
+                    f"{list(self.base)} to {list(after)}")
+        after_nulls = infer_nullability(plan, self.resolver)
+        if self.base_nulls is not None and after_nulls is not None:
+            if self.base_nulls != after_nulls:
+                moved = sorted(set(self.base_nulls.items())
+                               ^ set(after_nulls.items()))
+                raise PlanVerificationError(
+                    "rewrite-nullability-change", "root",
+                    f"optimizer rule {rule!r} changed root nullability: "
+                    f"{moved}")
 
 
 # -- pass 2: compiled-artifact lint -----------------------------------------

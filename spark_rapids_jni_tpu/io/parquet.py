@@ -1145,10 +1145,11 @@ class ParquetChunkedReader:
                (lo is not None and gmax < lo)
 
     def _chunks(self):
+        from ..utils.config import config
         from ..utils.memory import MemoryScope
         # the live-buffer census walks every live jax.Array, so per-batch
         # checkpoints only run when the observability is actually wanted
-        if not os.environ.get("SRJT_MEM_DEBUG"):
+        if not config.mem_debug:
             yield from self._chunks_raw()
             return
         with MemoryScope("parquet_chunked") as scope:

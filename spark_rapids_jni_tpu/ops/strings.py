@@ -120,6 +120,38 @@ def contains(col: Column, pat) -> Column:
     return Column(BOOL8, data=hit.astype(jnp.uint8), validity=_prop_valid(col))
 
 
+def equal(col: Column, other) -> Column:
+    """Elementwise ``==`` against a python string or another STRING column.
+
+    The kernel the interpreted Filter path lowers ``==``/``!=`` predicates
+    over STRING columns onto (executor._eval_expr) — raw ``col.data`` is a
+    chars buffer, so the generic jnp comparison is meaningless for strings.
+    """
+    mat, lengths = to_padded_bytes(col)
+    if isinstance(other, Column):
+        omat, olengths = to_padded_bytes(other)
+        w = max(mat.shape[1], omat.shape[1])
+        if mat.shape[1] < w:
+            mat = jnp.pad(mat, ((0, 0), (0, w - mat.shape[1])))
+        if omat.shape[1] < w:
+            omat = jnp.pad(omat, ((0, 0), (0, w - omat.shape[1])))
+        in_str = jnp.arange(w, dtype=_I32)[None, :] < lengths[:, None]
+        hit = (lengths == olengths) & \
+            jnp.where(in_str, mat == omat, True).all(axis=1)
+        return Column(BOOL8, data=hit.astype(jnp.uint8),
+                      validity=_prop_valid(col, other.validity))
+    pat = _literal(other)
+    if len(pat) == 0:
+        hit = lengths == 0
+    elif len(pat) > mat.shape[1]:
+        hit = jnp.zeros((len(col),), jnp.bool_)
+    else:
+        target = jnp.asarray(np.frombuffer(pat, np.uint8))
+        hit = (lengths == len(pat)) & \
+            (mat[:, :len(pat)] == target).all(axis=1)
+    return Column(BOOL8, data=hit.astype(jnp.uint8), validity=_prop_valid(col))
+
+
 def find(col: Column, pat) -> Column:
     """First byte index of ``pat`` per row, -1 when absent (cudf find())."""
     pat = _literal(pat)

@@ -32,6 +32,9 @@ environment flags read once at import:
 | ``SRJT_RETRY_BACKOFF_S`` | ``0.01`` | base retry backoff seconds (doubles per attempt, ±25% jitter) |
 | ``SRJT_QUERY_TIMEOUT_S`` | ``0`` | cooperative per-query deadline in seconds (0 = none; checked at chunk boundaries) |
 | ``SRJT_BRIDGE_TIMEOUT_S`` | ``60`` | per-op socket deadline on bridge client+server (0 = block forever, the pre-hardening behavior) |
+| ``SRJT_MEM_DEBUG``    | ``0``   | live-buffer census checkpoints + MemoryScope exit report (io chunked reader, utils/memory.py) |
+| ``SRJT_ROOFLINE_GBPS`` | ``0`` | device-bandwidth ceiling override for explain-analyze roofline fractions (0 = use BENCH_BASELINES.json pin) |
+| ``JAX_PLATFORMS``     | *(unset)* | jax platform list honored by the bridge server before its first jax touch |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
 reads the module-level singleton.
@@ -100,6 +103,9 @@ class Config:
     retry_backoff_s: float = 0.01  # base retry backoff (doubles/attempt)
     query_timeout_s: float = 0.0   # cooperative query deadline (0 = none)
     bridge_timeout_s: float = 60.0  # bridge per-op socket deadline (0=off)
+    mem_debug: bool = False      # live-buffer census + MemoryScope reports
+    roofline_gbps: float = 0.0   # explain-analyze ceiling override (0=pin)
+    jax_platforms: str = ""      # jax platform list ("" = jax's default)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -130,10 +136,31 @@ class Config:
             retry_backoff_s=_float_flag("SRJT_RETRY_BACKOFF_S", 0.01),
             query_timeout_s=_float_flag("SRJT_QUERY_TIMEOUT_S", 0.0),
             bridge_timeout_s=_float_flag("SRJT_BRIDGE_TIMEOUT_S", 60.0),
+            mem_debug=_bool_flag("SRJT_MEM_DEBUG", False),
+            roofline_gbps=_float_flag("SRJT_ROOFLINE_GBPS", 0.0),
+            jax_platforms=os.environ.get("JAX_PLATFORMS", "").strip(),
         )
 
 
 config = Config.from_env()
+
+
+def child_environ(default_platform: str = "cpu") -> dict:
+    """Environment for a spawned helper process.
+
+    A copy of ours with the package importable regardless of the child's
+    cwd (PYTHONPATH) and the jax platform defaulted — a second process
+    contending for a one-tenant TPU tunnel hangs at backend init, so
+    children land on CPU unless the caller overrides.  Lives here so
+    ``os.environ`` stays confined to this module (the config-env-read
+    lint); callers layer their own overrides on the returned dict.
+    """
+    e = dict(os.environ)
+    e.setdefault("JAX_PLATFORMS", default_platform)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    e["PYTHONPATH"] = pkg_root + os.pathsep + e.get("PYTHONPATH", "")
+    return e
 
 
 def refresh() -> Config:

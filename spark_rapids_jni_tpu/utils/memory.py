@@ -21,12 +21,13 @@ Env: ``SRJT_MEM_DEBUG=1`` logs every scope's high-water mark to stderr
 
 from __future__ import annotations
 
-import os
 import sys
 from contextlib import contextmanager
 from dataclasses import dataclass
 
 import jax
+
+from .config import config
 
 
 def _array_nbytes(a) -> int:
@@ -170,7 +171,7 @@ class MemoryScope:
             self.platform)["live_bytes"]
         if self.stats.end_bytes > self.stats.high_water_bytes:
             self.stats.high_water_bytes = self.stats.end_bytes
-        if os.environ.get("SRJT_MEM_DEBUG"):
+        if config.mem_debug:
             s = self.stats
             print(f"[mem] {s.name}: start={s.start_bytes} "
                   f"high={s.high_water_bytes} end={s.end_bytes} "

@@ -404,9 +404,14 @@ def test_repo_is_lint_clean_modulo_baseline(tmp_path):
     fresh = [v for v in violations
              if lint.baseline_key(v) not in grandfathered]
     assert fresh == [], fresh
-    # CLI discipline: clean against the baseline, nonzero when the same
-    # grandfathered findings count as new (the seeded-violation gate)
+    # The baseline burned down to empty (the historical env reads now route
+    # through utils/config.py) and must stay that way — new grandfathering
+    # is a regression, not a migration.
+    assert grandfathered == set()
+    # CLI discipline: clean against the shipped baseline, and an empty one
+    # is now equivalent.  The nonzero-exit path is exercised against a
+    # synthetic violation in tests/test_fuzz.py.
     assert lint.main(["--baseline", baseline_path]) == 0
     empty = tmp_path / "empty-baseline.json"
     empty.write_text('{"grandfathered": []}')
-    assert lint.main(["--baseline", str(empty)]) == 1
+    assert lint.main(["--baseline", str(empty)]) == 0

@@ -1,0 +1,267 @@
+"""Rewrite-soundness analyzer (docs/ANALYSIS.md): the seeded plan-space
+fuzzer + shrinker, the verify() nullability/overflow lattice upgrades,
+q_error clamps, and the concurrency lint.
+
+The premerge CI gate runs the full 50-plan smoke corpus
+(``tools/srjt_fuzz.py --smoke``); these tests keep the corpora small and
+instead pin the properties the gate relies on: determinism, a clean small
+corpus, and — the analyzer's reason to exist — that a deliberately broken
+optimizer rule IS caught and shrunk to a minimal repro.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.engine import optimizer
+from spark_rapids_jni_tpu.engine import fuzz
+from spark_rapids_jni_tpu.engine.plan import (Aggregate, Exchange, Filter,
+                                              Join, Scan, col, lit,
+                                              topo_nodes)
+from spark_rapids_jni_tpu.engine.verify import (NULL_MAYBE, NULL_NEVER,
+                                                PlanVerificationError,
+                                                RewriteChecker,
+                                                infer_nullability, verify)
+from spark_rapids_jni_tpu.utils.metrics import q_error
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# q_error clamps (the AQE evidence plane's scoring function)
+
+
+def test_q_error_clamps_zero_rows():
+    # both sides clamp to 1 row so empty results stay finite
+    assert q_error(0, 0) == 1.0
+    assert q_error(0, 500) == 500.0
+    assert q_error(1000, 0) == 1000.0
+    assert q_error(8, None) == 8.0  # actual None counts as 0 rows
+
+
+def test_q_error_unknown_estimate_is_unscorable():
+    assert q_error(None, 42) is None
+    assert q_error(10, 10) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# plan-space fuzzer: determinism, clean corpus, broken-rule injection
+
+
+def test_warehouse_and_plan_generation_deterministic(tmp_path):
+    cat1 = fuzz.gen_warehouse(tmp_path / "a", np.random.default_rng([7, 0]))
+    cat2 = fuzz.gen_warehouse(tmp_path / "b", np.random.default_rng([7, 0]))
+    for name in cat1:
+        assert cat1[name]["df"].equals(cat2[name]["df"]), name
+    for i in range(10):
+        p1 = fuzz.gen_plan(np.random.default_rng([7, i + 1]), cat1)
+        p2 = fuzz.gen_plan(np.random.default_rng([7, i + 1]), cat1)
+        assert p1.serialize() == p2.serialize()
+
+
+def test_fuzz_corpus_clean(tmp_path):
+    rep = fuzz.run_corpus(5, 3, tmp_path, variants=fuzz.VARIANTS)
+    assert rep["cases"] == 3
+    assert rep["failures"] == []
+
+
+def _negate_first_filter(opt):
+    for n in topo_nodes(opt):
+        if isinstance(n, Filter):
+            return fuzz._replace(opt, n,
+                                 Filter(n.child, ("not", n.predicate)))
+    return opt
+
+
+def test_broken_rule_caught_and_shrunk(tmp_path):
+    """The acceptance gate: a deliberately-broken optimizer rule
+    (test-injected predicate negation — schema-preserving, so it sails
+    through verify()) must be caught by the differential harness and
+    shrunk to a minimal reproducible plan."""
+    def sabotaged(plan, distribute=False):
+        return _negate_first_filter(
+            optimizer.optimize(plan, distribute=distribute))
+
+    rep = fuzz.run_corpus(99, 3, tmp_path, variants=fuzz.VARIANTS[:2],
+                          optimize_fn=sabotaged)
+    assert rep["failures"], "sabotaged optimizer escaped the harness"
+    for f in rep["failures"]:
+        assert f["minimal_nodes"] <= f["plan_nodes"]
+        assert f["minimal_plan"]["nodes"]  # serialized repro present
+    parity = [f for f in rep["failures"] if f["check"] == "oracle-parity"]
+    assert parity, "predicate negation must surface as an oracle mismatch"
+    # the shrinker strips the plan down to (near) the Scan+Filter core
+    assert min(f["minimal_nodes"] for f in parity) <= 3
+
+
+# ---------------------------------------------------------------------------
+# verify(): order-sensitive exchange, overflow lattice, nullability lattice
+
+
+@pytest.fixture(scope="module")
+def tiny(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path_factory.mktemp("soundness")
+    p = d / "t.parquet"
+    pq.write_table(pa.table({
+        "k": pa.array([1, 1, 2], type=pa.int64()),
+        "v": pa.array([1.0, 2.0, 3.0]),
+        "s": pa.array(["ash", None, "dome"]),
+        "s2": pa.array(["ash", "birch", "dome"]),
+        "i": pa.array([1, 2, 3], type=pa.int32()),
+    }), p)
+    return str(p)
+
+
+def test_verify_rejects_exchange_under_order_sensitive_agg(tiny):
+    plan = Aggregate(Exchange(Scan(tiny), ("k",), "hash"),
+                     ("k",), (("v", "first"),), ("f",))
+    with pytest.raises(PlanVerificationError) as ei:
+        verify(plan)
+    assert ei.value.code == "order-sensitive-exchange"
+    # the same shape with an order-insensitive agg is legal
+    ok = Aggregate(Exchange(Scan(tiny), ("k",), "hash"),
+                   ("k",), (("v", "sum"),), ("sv",))
+    assert verify(ok) is not None
+
+
+def test_verify_overflow_unsafe_literals(tiny):
+    # int literal outside the int32 storage range
+    with pytest.raises(PlanVerificationError) as ei:
+        verify(Filter(Scan(tiny), (">", col("i"), lit(2 ** 40))))
+    assert ei.value.code == "overflow-unsafe-cast"
+    # int literal beyond float64's exact-integer range vs a float column
+    with pytest.raises(PlanVerificationError) as ei:
+        verify(Filter(Scan(tiny), ("<", col("v"), lit(2 ** 54))))
+    assert ei.value.code == "overflow-unsafe-cast"
+    # in-range literals pass
+    assert verify(Filter(Scan(tiny), (">", col("i"), lit(1000)))) is not None
+
+
+def test_verify_rejects_string_ordering_comparison(tiny):
+    with pytest.raises(PlanVerificationError) as ei:
+        verify(Filter(Scan(tiny), ("<", col("s"), lit("m"))))
+    assert ei.value.code == "invalid-cast"
+    assert verify(Filter(Scan(tiny), ("==", col("s"), lit("m")))) is not None
+
+
+def test_nullability_lattice(tiny):
+    nulls = infer_nullability(Scan(tiny))
+    assert nulls["k"] == NULL_NEVER      # footer null_count == 0
+    assert nulls["s"] == NULL_MAYBE      # one None in the file
+    # a Filter referencing a column proves it non-null downstream (the
+    # executor ANDs every referenced column's validity into the keep mask)
+    f = Filter(Scan(tiny), ("==", col("s"), lit("ash")))
+    assert infer_nullability(f)["s"] == NULL_NEVER
+    # left join pads the right side: right non-key columns widen to MAYBE
+    j = Join(Scan(tiny), Scan(tiny), ("k",), ("k",), how="left")
+    jn = infer_nullability(j)
+    assert jn["v"] == NULL_NEVER
+    assert jn["v_r"] == NULL_MAYBE
+    # count never returns null
+    agg = Aggregate(Scan(tiny), ("k",), (("s", "count"),), ("n",))
+    assert infer_nullability(agg)["n"] == NULL_NEVER
+
+
+def test_rewrite_checker_catches_nullability_change(tiny):
+    base = Filter(Scan(tiny), ("==", col("s"), lit("ash")))
+    rc = RewriteChecker(base)
+    rc.check("noop", base)  # identity rewrite passes
+    with pytest.raises(PlanVerificationError) as ei:
+        rc.check("drop-filter", Scan(tiny))  # schema same, nullability moved
+    assert ei.value.code == "rewrite-nullability-change"
+    assert "s" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# string equality in the interpreted Filter path (fuzzer-found bug)
+
+
+def test_string_predicate_filters_like_pandas(tiny):
+    from spark_rapids_jni_tpu.engine.executor import execute
+    # != literal: the None row drops under SQL comparison semantics
+    out = execute(Filter(Scan(tiny), ("!=", col("s"), lit("dome"))))
+    assert out.column("s").to_pylist() == ["ash"]
+    # == between two string columns
+    out = execute(Filter(Scan(tiny), ("==", col("s"), col("s2"))))
+    assert out.column("s").to_pylist() == ["ash", "dome"]
+    # ordering comparison over strings raises rather than computing nonsense
+    with pytest.raises(ValueError, match="string comparison"):
+        execute(Filter(Scan(tiny), ("<", col("s"), lit("m"))))
+
+
+# ---------------------------------------------------------------------------
+# concurrency lint
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "srjt_lint", os.path.join(REPO, "tools", "srjt_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_SYNTHETIC_BAD = '''
+import threading
+_REGISTRY = {}
+_EVENTS = []
+_lock = threading.Lock()
+
+def record(k, v):
+    _REGISTRY[k] = v      # unguarded write: must be flagged
+    _EVENTS.append(v)     # unguarded mutation: must be flagged
+
+_REGISTRY["boot"] = 1     # module scope (import time): exempt
+'''
+
+_SYNTHETIC_GOOD = '''
+import threading
+_REGISTRY = {}
+_lock = threading.Lock()
+
+def record(k, v):
+    with _lock:
+        _REGISTRY[k] = v
+
+def _record_locked(k, v):
+    """Write one entry (lock held)."""
+    _REGISTRY[k] = v
+'''
+
+
+def test_concurrency_lint_exits_nonzero_on_synthetic(tmp_path, monkeypatch,
+                                                     capsys):
+    L = _load_lint()
+    pkg = tmp_path / "spark_rapids_jni_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(_SYNTHETIC_BAD)
+    monkeypatch.setattr(L, "REPO", str(tmp_path))
+    monkeypatch.setattr(L, "dispatch_pass", lambda: [])
+    assert L.main([]) == 1
+    out = capsys.readouterr().out
+    assert "unlocked-global-write" in out
+    assert out.count("unlocked-global-write") == 2  # module scope exempt
+    # lock-guarded and "(lock held)"-documented writes are clean
+    (pkg / "bad.py").write_text(_SYNTHETIC_GOOD)
+    assert L.main([]) == 0
+
+
+def test_lint_clean_on_real_codebase_with_empty_baseline():
+    """The grandfathered env-read baseline is burned down to empty and the
+    registry-lock/ceiling-cache fixes leave zero concurrency findings."""
+    base_path = os.path.join(REPO, "ci", "lint-baseline.json")
+    with open(base_path) as f:
+        assert json.load(f)["grandfathered"] == []
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "srjt_lint.py"),
+         "--baseline", base_path],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr

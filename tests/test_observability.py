@@ -133,8 +133,13 @@ def test_chunked_reader_mem_debug_path(tmp_path, monkeypatch):
     p = tmp_path / "m.parquet"
     pq.write_table(t, p, row_group_size=1_000)
     monkeypatch.setenv("SRJT_MEM_DEBUG", "1")
-    total = sum(tb.num_rows for tb in
-                ParquetChunkedReader(p, pass_read_limit=8 << 10))
+    cfg.refresh()
+    try:
+        total = sum(tb.num_rows for tb in
+                    ParquetChunkedReader(p, pass_read_limit=8 << 10))
+    finally:
+        monkeypatch.delenv("SRJT_MEM_DEBUG")
+        cfg.refresh()
     assert total == n
 
 
@@ -356,7 +361,12 @@ def test_explain_analyze_roofline_columns(metrics_warehouse, monkeypatch):
     ceiling (SRJT_ROOFLINE_GBPS wins over BENCH_BASELINES.json)."""
     from spark_rapids_jni_tpu.engine import explain_analyze
     monkeypatch.setenv("SRJT_ROOFLINE_GBPS", "100.0")
-    rep = explain_analyze(_agg_plan(metrics_warehouse), fused=True)
+    cfg.refresh()
+    try:
+        rep = explain_analyze(_agg_plan(metrics_warehouse), fused=True)
+    finally:
+        monkeypatch.delenv("SRJT_ROOFLINE_GBPS")
+        cfg.refresh()
     root = rep.nodes[-1]["metrics"]
     assert root["bytes_moved"] > 0
     assert root["GBps"] is not None and root["GBps"] > 0
@@ -380,7 +390,9 @@ def test_roofline_ceiling_from_baselines_file():
     device_bandwidth_ceiling_GBps pin in BENCH_BASELINES.json."""
     from spark_rapids_jni_tpu.engine import explain as ex
     assert "SRJT_ROOFLINE_GBPS" not in os.environ
-    ex._ceiling_cache[0] = False  # force a re-read
+    assert cfg.config.roofline_gbps == 0.0
+    with ex._ceiling_lock:
+        ex._ceiling_cache[0] = False  # force a re-read
     ceiling = ex.roofline_ceiling_gbps()
     assert ceiling == pytest.approx(562.11)
 

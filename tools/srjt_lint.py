@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Repo lint for the engine's static invariants (docs/ANALYSIS.md pass 3).
 
-Four stdlib-``ast`` rules over ``spark_rapids_jni_tpu/``:
+Five stdlib-``ast`` rules over ``spark_rapids_jni_tpu/`` + ``tools/``:
 
 - **traced-host-op** — no ``.item()`` / ``float()`` / ``bool()`` / ``int()``
   / ``np.asarray`` / ``.tolist()`` / ``jax.device_get`` /
@@ -11,22 +11,36 @@ Four stdlib-``ast`` rules over ``spark_rapids_jni_tpu/``:
   zero-sync fused chunk program into a per-chunk host round-trip.
 - **config-env-read** — ``os.environ`` / ``os.getenv`` only in
   ``utils/config.py``; everything else reads the ``config`` singleton so
-  ``refresh()`` stays the one switchboard.  Pre-existing sites are
-  grandfathered in ``ci/lint-baseline.json``.
+  ``refresh()`` stays the one switchboard.  Env *writes*
+  (``os.environ.setdefault``/``os.environ[k] = v`` — how the CLI tools pin
+  ``JAX_PLATFORMS`` before the first jax import) are exempt.  Pre-existing
+  read sites are grandfathered in ``ci/lint-baseline.json``.
+- **unlocked-global-write** — ahead of AQE's runtime re-planning (a second
+  thread touching planner state), any write to a module-level mutable
+  container (dict/list/set/deque assignments at module scope) from inside a
+  function must sit under a ``with <lock>:`` block — mutating method calls
+  (``append``/``update``/``setdefault``/...), subscript stores, ``del``,
+  augmented assigns, and rebinds via ``global``.  Two exemptions: writes at
+  module scope (import-time is single-threaded) and functions whose
+  docstring carries the ``(lock held)`` convention (see faults._arm),
+  which asserts the caller already owns the lock.
 - **host-sync-site** — every ``metrics.host_sync(...)`` call site must
   carry a ``label=`` that is a literal member of ``verify.SYNC_WHITELIST``:
   adding a fourth deliberate sync means adding it to the whitelist, in
   one reviewable diff.
 - **bare-except** — no bare ``except:`` under ``bridge/`` / ``engine/`` /
-  ``parallel/``: the recovery layer (engine/recovery.py) dispatches on the
-  ``utils/errors`` taxonomy, and a bare catch swallows cancellation and
-  resource exhaustion indistinguishably.
+  ``parallel/`` / ``utils/`` / ``tools/``: the recovery layer
+  (engine/recovery.py) dispatches on the ``utils/errors`` taxonomy, and a
+  bare catch swallows cancellation and resource exhaustion
+  indistinguishably.
 
 Plus two import-time passes:
 
 - **dispatch exhaustiveness** — every class in ``plan._NODE_TYPES`` must be
-  registered in ``executor._EXEC_DISPATCH``, ``explain._DESCRIBE``, and
-  ``verify._INFER`` (a new plan node can't silently miss a layer).
+  registered in ``executor._EXEC_DISPATCH``, ``explain._DESCRIBE``,
+  ``verify._INFER``, ``verify._NULLS`` (nullability lattice), and
+  ``fuzz._ORACLE`` (pandas differential oracle) — a new plan node can't
+  silently miss a layer.
 - **``--segments``** — build the bench smoke warehouse in a tempdir, lower
   the optimized q5-lite + chunked plans' fused segments to jaxprs
   (``verify.lint_plan_artifacts``, nothing executes) and assert the static
@@ -62,11 +76,68 @@ TRACED_FUNCS = {
 #: attribute calls that concretize a tracer / pull data to host
 #: subtrees where a bare `except:` is a lint violation — the failure-domain
 #: hardening (engine/recovery.py) depends on every catch being classifiable
-_NO_BARE_EXCEPT = (f"{PKG}/bridge/", f"{PKG}/engine/", f"{PKG}/parallel/")
+_NO_BARE_EXCEPT = (f"{PKG}/bridge/", f"{PKG}/engine/", f"{PKG}/parallel/",
+                   f"{PKG}/utils/", "tools/")
 
 _HOST_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
 #: builtin casts that concretize when applied to a traced array
 _HOST_NAME_CALLS = {"float", "int", "bool"}
+
+#: constructors whose module-level assignment marks a name as shared
+#: mutable state for the unlocked-global-write rule
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                  "OrderedDict", "Counter", "WeakValueDictionary"}
+#: method calls that mutate a container in place
+_MUTATING_METHODS = {"append", "appendleft", "add", "update", "setdefault",
+                     "pop", "popitem", "popleft", "clear", "extend",
+                     "insert", "remove", "discard"}
+#: identifier substrings that mark a `with` context as a mutual-exclusion
+#: guard (threading.Lock/RLock/Condition naming conventions in this repo)
+_LOCKISH = ("lock", "cond", "mutex", "_cv")
+#: docstring marker asserting the caller already holds the guarding lock
+_LOCK_HELD_DOC = "(lock held)"
+
+
+def _module_mutable_globals(tree: ast.Module) -> set:
+    """Names bound at module scope to a mutable container literal/ctor."""
+    names: set = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                     ast.ListComp, ast.SetComp,
+                                     ast.DictComp)) or (
+            isinstance(value, ast.Call) and (
+                (isinstance(value.func, ast.Name)
+                 and value.func.id in _MUTABLE_CTORS) or
+                (isinstance(value.func, ast.Attribute)
+                 and value.func.attr in _MUTABLE_CTORS)))
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and \
+                    not any(s in t.id.lower() for s in _LOCKISH):
+                names.add(t.id)
+    return names
+
+
+def _is_os_environ(node) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+def _mentions_lock(expr) -> bool:
+    for n in ast.walk(expr):
+        ident = n.id if isinstance(n, ast.Name) else \
+            n.attr if isinstance(n, ast.Attribute) else None
+        if ident is not None and \
+                any(s in ident.lower() for s in _LOCKISH):
+            return True
+    return False
 
 
 def _violation(code: str, path: str, line: int, detail: str) -> dict:
@@ -80,22 +151,98 @@ def baseline_key(v: dict) -> str:
 
 
 class _FileLint(ast.NodeVisitor):
-    def __init__(self, relpath: str, whitelist: tuple):
+    def __init__(self, relpath: str, whitelist: tuple,
+                 mutable_globals: set = frozenset()):
         self.relpath = relpath
         self.traced = TRACED_FUNCS.get(relpath, set())
         self.whitelist = whitelist
+        self.mutable_globals = mutable_globals
         self.out: list = []
         self._traced_depth = 0
+        self._func_depth = 0
+        self._lock_depth = 0
+        self._global_decls: set = set()
+        self._env_writes: set = set()  # id()s of exempt os.environ nodes
 
     def visit_FunctionDef(self, node):
         entered = node.name in self.traced
         if entered:
             self._traced_depth += 1
+        doc = ast.get_docstring(node)
+        held = doc is not None and _LOCK_HELD_DOC in doc
+        if held:
+            self._lock_depth += 1
+        self._func_depth += 1
+        saved_decls = self._global_decls
+        self._global_decls = set(saved_decls)
         self.generic_visit(node)
+        self._global_decls = saved_decls
+        self._func_depth -= 1
+        if held:
+            self._lock_depth -= 1
         if entered:
             self._traced_depth -= 1
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        locked = any(_mentions_lock(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._global_decls.update(node.names)
+
+    # -- unlocked-global-write ---------------------------------------------
+
+    def _flag_global_write(self, name: str, lineno: int, how: str) -> None:
+        if name not in self.mutable_globals:
+            return
+        if self._func_depth == 0 or self._lock_depth > 0:
+            return  # import-time init / guarded by a lock context
+        self.out.append(_violation(
+            "unlocked-global-write", self.relpath, lineno,
+            f"{how} of module global {name!r} outside a lock context "
+            f"(wrap in `with <lock>:` or document `(lock held)`)"))
+
+    def _check_store_target(self, target, lineno: int) -> None:
+        if isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Name):
+            self._flag_global_write(target.value.id, lineno,
+                                    "subscript store")
+        elif isinstance(target, ast.Name) and \
+                target.id in self._global_decls:
+            self._flag_global_write(target.id, lineno, "rebind")
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_store_target(elt, lineno)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and _is_os_environ(t.value):
+                self._env_writes.add(id(t.value))  # env WRITE: exempt
+            self._check_store_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name):
+                self._flag_global_write(t.value.id, node.lineno,
+                                        "subscript delete")
+            if isinstance(t, ast.Subscript) and _is_os_environ(t.value):
+                self._env_writes.add(id(t.value))
+        self.generic_visit(node)
 
     def _check_traced_call(self, node: ast.Call) -> None:
         fn = node.func
@@ -139,12 +286,21 @@ class _FileLint(ast.NodeVisitor):
         if self._traced_depth:
             self._check_traced_call(node)
         self._check_host_sync(node)
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and \
+                    fn.attr in _MUTATING_METHODS:
+                self._flag_global_write(fn.value.id, node.lineno,
+                                        f".{fn.attr}() call")
+            if fn.attr == "setdefault" and _is_os_environ(fn.value):
+                self._env_writes.add(id(fn.value))  # env WRITE: exempt
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
         if self.relpath != f"{PKG}/utils/config.py" and \
                 isinstance(node.value, ast.Name) and node.value.id == "os" \
-                and node.attr in ("environ", "getenv"):
+                and node.attr in ("environ", "getenv") \
+                and id(node) not in self._env_writes:
             self.out.append(_violation(
                 "config-env-read", self.relpath, node.lineno,
                 f"os.{node.attr} outside utils/config.py"))
@@ -162,20 +318,23 @@ class _FileLint(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def ast_pass(whitelist: tuple) -> list:
+def ast_pass(whitelist: tuple, roots: tuple = (PKG, "tools")) -> list:
     violations: list = []
-    for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, PKG)):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fname)
-            rel = os.path.relpath(full, REPO)
-            with open(full) as f:
-                tree = ast.parse(f.read(), filename=rel)
-            lint = _FileLint(rel, whitelist)
-            lint.visit(tree)
-            violations += lint.out
+    for root in roots:
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(REPO, root)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, REPO)
+                with open(full) as f:
+                    tree = ast.parse(f.read(), filename=rel)
+                lint = _FileLint(rel, whitelist,
+                                 _module_mutable_globals(tree))
+                lint.visit(tree)
+                violations += lint.out
     return violations
 
 
@@ -187,9 +346,12 @@ def dispatch_pass() -> list:
     # engine/__init__ re-exports the verify() function under the submodule's
     # name, so resolve the module through importlib
     verify_mod = importlib.import_module("spark_rapids_jni_tpu.engine.verify")
+    fuzz_mod = importlib.import_module("spark_rapids_jni_tpu.engine.fuzz")
     tables = (("executor._EXEC_DISPATCH", executor._EXEC_DISPATCH),
               ("explain._DESCRIBE", explain._DESCRIBE),
-              ("verify._INFER", verify_mod._INFER))
+              ("verify._INFER", verify_mod._INFER),
+              ("verify._NULLS", verify_mod._NULLS),
+              ("fuzz._ORACLE", fuzz_mod._ORACLE))
     out: list = []
     for cls in plan._NODE_TYPES.values():
         for name, table in tables:
