@@ -1055,7 +1055,10 @@ if _m.enabled():
     node_lines = [ln for ln in rep.text.splitlines()
                   if ln.strip() and not ln.lstrip().startswith("--")]
     cen = decision_census(optimize(mkplan(), distribute=True), dist=True)
-    pathed = sum(1 for d in rep.decisions if "path" in d)
+    # runtime (adaptive:*) entries carry a path too but are deliberately
+    # outside the static census — census counts PLANNED structure only
+    pathed = sum(1 for d in rep.decisions
+                 if "path" in d and not d.get("runtime"))
     dev_attrib["evidence"] = {{
         "node_lines_annotated": all("est_rows=" in ln and "q_error=" in ln
                                     for ln in node_lines),
@@ -1140,6 +1143,205 @@ print(json.dumps({{
         return json.loads(lines[-1])
     except Exception as e:
         print(f"engine-dist bench failed: {e!r}", file=_sys.stderr)
+        return None
+
+
+def bench_engine_aqe(n_fact=240_000, n_keys=2_000, smoke=False):
+    """Adaptive execution (SRJT_AQE) A/Bs on the virtual 8-device mesh.
+
+    Two experiments, both with runtime rewrites verified and parity
+    asserted against the AQE-off single-device plan:
+
+    - **skewed vs balanced twin**: the same groupby-mean plan over two
+      facts that differ only in key distribution (half the skewed fact
+      sits on ONE key).  mean is non-decomposable, so the FULL input
+      crosses the exchange on the group key — without AQE the hot
+      destination inflates the padded all_to_all capacity for every
+      device.  With ``SRJT_AQE=1`` the skew-split rule re-deals the hot
+      destinations' rows round-robin; ``skew_ratio`` (skewed / balanced
+      wall time, both AQE-on) is the headline, with the applied
+      ``adaptive:skew_split`` ledger entry and the post-split
+      ``engine.exchange.skew`` gauge as the structural evidence.
+    - **repeat-query cold vs warmed**: a join whose build side is a
+      selective Filter — the footer estimate (the UN-filtered row count)
+      sits above the broadcast threshold so run 1 plans a shuffle join,
+      but the measured actual sits below it.  Run 2 of the same source
+      fingerprint reads run 1's profile (``SRJT_PROFILE_DIR``) and plans
+      the broadcast join outright (``adaptive:history_warmed``);
+      ``rerun_vs_first`` is warmed / cold wall time.
+
+    Wall-clock ratios are gated report-only (BENCH_BASELINES.json —
+    machine noise at smoke scale); the structural evidence on this line
+    is what ci/premerge.sh asserts.
+    """
+    import subprocess
+    import os
+    import sys as _sys
+    script = f"""
+import json, os, tempfile, time
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import spark_rapids_jni_tpu
+import jax
+root = tempfile.mkdtemp()
+rng = np.random.default_rng(21)
+nf, nk = {n_fact}, {n_keys}
+
+from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Join, Scan, col,
+                                         execute, lit, new_stats, optimize)
+from spark_rapids_jni_tpu.engine.plan import Exchange, topo_nodes
+from spark_rapids_jni_tpu.utils import metrics as _m
+from spark_rapids_jni_tpu.utils.config import refresh
+
+v = np.round(rng.uniform(0, 100, nf), 3)
+k_bal = rng.integers(0, nk, nf)
+k_skew = k_bal.copy()
+k_skew[: nf // 2] = 3      # one hot key: half the fact routes to one device
+for name, kk in (("bal", k_bal), ("skew", k_skew)):
+    pq.write_table(pa.table({{"k": pa.array(kk, pa.int64()),
+                              "v": pa.array(v, pa.float64())}}),
+                   os.path.join(root, name + ".parquet"),
+                   row_group_size=32_000)
+
+def meanplan(path):
+    # mean is non-decomposable: no partial pushes below the exchange, the
+    # full input crosses the wire keyed on k — a hot key is a genuinely
+    # hot destination device, the shape the skew-split rule exists for
+    return Aggregate(Scan(path), ("k",), (("v", "mean"),), ("m",))
+
+def timed(opt):
+    stats = new_stats()
+    execute(opt, new_stats())                       # warm (compile)
+    t0 = time.perf_counter()
+    out = execute(opt, stats)
+    jax.block_until_ready([c.data for c in out.columns])
+    return time.perf_counter() - t0, out, stats
+
+def norm(t):
+    cols = sorted(zip(t.names, (c.to_numpy() for c in t.columns)))
+    order = np.argsort(cols[0][1], kind="stable")
+    return [(n, np.round(a[order], 4).tolist()) for n, a in cols]
+
+# -- skewed vs balanced twin, both under AQE --------------------------------
+SKEW_THRESHOLD = 2.0
+os.environ["SRJT_AQE"] = "1"
+os.environ["SRJT_AQE_SKEW"] = str(SKEW_THRESHOLD)
+refresh()
+t_bal, out_bal, st_bal = timed(optimize(
+    meanplan(os.path.join(root, "bal.parquet")), distribute=True))
+opt_skew = optimize(meanplan(os.path.join(root, "skew.parquet")),
+                    distribute=True)
+t_skew, out_skew, st_skew = timed(opt_skew)
+splits = [d for d in getattr(opt_skew, "_decisions", ())
+          if d.get("kind") == "adaptive:skew_split" and d.get("triggered")]
+# the gauge holds the LAST exchange's post-placement skew — the skewed
+# run's split exchange, read before anything else executes
+gauge_skew = (_m.gauges_snapshot("engine.exchange.skew")
+              .get("engine.exchange.skew") if _m.enabled() else None)
+
+os.environ["SRJT_AQE"] = "0"
+refresh()
+base_skew = execute(optimize(meanplan(os.path.join(root, "skew.parquet"))),
+                    new_stats())
+base_bal = execute(optimize(meanplan(os.path.join(root, "bal.parquet"))),
+                   new_stats())
+skew_parity = bool(norm(out_skew) == norm(base_skew)
+                   and norm(out_bal) == norm(base_bal))
+
+# -- repeat-query cold vs history-warmed ------------------------------------
+# fresh store: the newest-profile-by-fingerprint lookup must see exactly
+# run 1, not whatever the inherited smoke store holds
+os.environ["SRJT_PROFILE_DIR"] = tempfile.mkdtemp(prefix="srjt-aqe-warm-")
+os.environ["SRJT_AQE"] = "1"
+os.environ["SRJT_BROADCAST_ROWS"] = "100"
+refresh()
+nd = 500
+dk = np.arange(nd, dtype=np.int64)
+pq.write_table(pa.table({{"dk": pa.array(dk), "grp": pa.array(dk % 7)}}),
+               os.path.join(root, "dim.parquet"))
+# a WIDE fact for the repeat-query A/B: the cold shuffle join pays wire
+# for every payload column, the warmed broadcast join pays none of them —
+# the same asymmetry the dist bench measures, here it is what makes run 2
+# strictly faster rather than noise-level
+pq.write_table(pa.table({{"k": pa.array(k_bal, pa.int64()),
+                          "v": pa.array(v, pa.float64()),
+                          "v2": pa.array(rng.integers(-100, 100, nf),
+                                         pa.int64()),
+                          "v3": pa.array(rng.integers(0, 1000, nf),
+                                         pa.int64())}}),
+               os.path.join(root, "warm.parquet"), row_group_size=32_000)
+
+def joinplan():
+    # the Filter keeps 50 of 500 dim rows; the footer estimate is the
+    # UN-filtered 500 (> broadcast threshold 100) so the cold run plans a
+    # shuffle join — the measured actual (50, under the threshold) is
+    # what run 2 warms from
+    dim = Filter(Scan(os.path.join(root, "dim.parquet")),
+                 ("<", col("dk"), lit(50)))
+    # unchunked probe: both plans materialize the fact once, so the A/B
+    # isolates the planned exchange (what warming removes) instead of
+    # mixing in per-chunk dispatch overhead on the shared-core mesh
+    j = Join(Scan(os.path.join(root, "warm.parquet")),
+             dim, ("k",), ("dk",), "inner")
+    return Aggregate(j, ("grp",),
+                     (("v", "sum"), ("v2", "sum"), ("v3", "sum"),
+                      ("v", "count")),
+                     ("total", "t2", "t3", "n"))
+
+def kinds(opt):
+    return sorted(e.kind for e in topo_nodes(opt) if isinstance(e, Exchange))
+
+opt1 = optimize(joinplan(), distribute=True)
+t1, out1, st1 = timed(opt1)
+opt2 = optimize(joinplan(), distribute=True)    # reads run 1's profile
+t2, out2, st2 = timed(opt2)
+warmed = [d for d in getattr(opt2, "_decisions", ())
+          if d.get("kind") == "adaptive:history_warmed"]
+warm_parity = bool(norm(out1) == norm(out2))
+
+print(json.dumps({{
+    "balanced_s": t_bal, "skewed_s": t_skew,
+    "skew_ratio": t_skew / t_bal if t_bal else None,
+    "skew": {{"splits_applied": len(splits),
+              "aqe_splits": st_skew["aqe_splits"],
+              "pre_skew": splits[0].get("measured_skew") if splits else None,
+              "post_skew": splits[0].get("post_skew") if splits else None,
+              "gauge_skew": gauge_skew,
+              "threshold": SKEW_THRESHOLD,
+              "parity": skew_parity}},
+    "first_s": t1, "rerun_s": t2,
+    "rerun_vs_first": t2 / t1 if t1 else None,
+    "warm": {{"warmed_entries": len(warmed),
+              "choice": warmed[0].get("choice") if warmed else None,
+              "run1_kinds": kinds(opt1), "run2_kinds": kinds(opt2),
+              "run1_flips": st1["aqe_flips"],
+              "run2_broadcast_planned": bool(
+                  "broadcast" in kinds(opt2)
+                  and "broadcast" not in kinds(opt1)),
+              "faster": bool(t2 < t1),
+              "parity": warm_parity}}}}))
+"""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"),
+               JAX_ENABLE_X64="1",
+               # gauge + profile evidence need the metrics layer on even
+               # when the parent runs bare
+               SRJT_METRICS="1")
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([_sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=900)
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            print(f"engine-aqe bench failed (rc={r.returncode}):\n"
+                  f"{r.stderr[-2000:]}", file=_sys.stderr)
+            return None
+        return json.loads(lines[-1])
+    except Exception as e:
+        print(f"engine-aqe bench failed: {e!r}", file=_sys.stderr)
         return None
 
 
@@ -1277,9 +1479,39 @@ def smoke():
                           if dres["ratios"]["broadcast_vs_exchange"]
                           else None,
                       }}))
-    # sixth line: the query-profile store — every query above (this
-    # process AND the dist subprocess, via the inherited env) persisted a
-    # profile; the store summary must carry the dist exchanges' skew
+    # sixth line: adaptive execution — the skewed twin must apply at least
+    # one verified skew split (post-split skew gauge under the threshold)
+    # and the repeat query must plan run 2 from run 1's measured actuals,
+    # with bit-parity everywhere.  skew_ratio / rerun_vs_first are the
+    # report-only gate keys (aqe.* in BENCH_BASELINES.json)
+    ares = bench_engine_aqe(n_fact=60_000, n_keys=500, smoke=True)
+    askew = (ares or {}).get("skew") or {}
+    awarm = (ares or {}).get("warm") or {}
+    aok = bool(ares and askew.get("parity") and awarm.get("parity")
+               and askew.get("splits_applied", 0) >= 1
+               # gauge absent = metrics off in subprocess, nothing to check
+               and (askew.get("gauge_skew") is None
+                    or askew["gauge_skew"] < askew["threshold"])
+               and awarm.get("warmed_entries", 0) >= 1
+               and awarm.get("run2_broadcast_planned")
+               and awarm.get("faster"))
+    print(json.dumps({"metric": "aqe",
+                      "ok": aok,
+                      "skew_ratio": round(ares["skew_ratio"], 4)
+                      if ares and ares.get("skew_ratio") else None,
+                      "rerun_vs_first": round(ares["rerun_vs_first"], 4)
+                      if ares and ares.get("rerun_vs_first") else None,
+                      "latency_ms": {} if not ares else {
+                          "balanced": round(ares["balanced_s"] * 1e3, 3),
+                          "skewed": round(ares["skewed_s"] * 1e3, 3),
+                          "first": round(ares["first_s"] * 1e3, 3),
+                          "rerun": round(ares["rerun_s"] * 1e3, 3),
+                      },
+                      "skew": askew or None,
+                      "warm": awarm or None}))
+    # profile-store line: every query above (this process AND the dist +
+    # aqe subprocesses, via the inherited env) persisted a profile; the
+    # store summary must carry the dist exchanges' skew
     from spark_rapids_jni_tpu.utils import profile
     psumm = profile.store_summary()
     pok = (not profile.enabled()) or (
@@ -1288,7 +1520,7 @@ def smoke():
                       "ok": pok,
                       "enabled": profile.enabled(),
                       **psumm}))
-    # seventh line: the observability layer's own price — the same tiny
+    # overhead line: the observability layer's own price — the same tiny
     # aggregate timed under SRJT_METRICS=0 and =1.  The on/off ratio is
     # gated report-only (machine noise dwarfs the per-chunk dict writes
     # at smoke scale); the line exists so a pathological regression in
@@ -1339,7 +1571,8 @@ def smoke():
                       },
                       "ratios": {"on_vs_off": round(ov_ratio, 4)
                                  if ov_ratio else None}}))
-    return 0 if (ok and jok and mok and tok and dok and pok and vok) else 1
+    return 0 if (ok and jok and mok and tok and dok and aok and pok
+                 and vok) else 1
 
 
 def main():
@@ -1357,6 +1590,7 @@ def main():
     pipe = bench_engine_pipeline()
     ejoin = bench_engine_join()
     edist = bench_engine_dist()
+    eaqe = bench_engine_aqe()
 
     # vs_baseline is measured/PINNED (BENCH_BASELINES.json), so the ratio is
     # comparable across rounds; the live re-measure of each baseline is
@@ -1527,6 +1761,26 @@ def main():
                         "the r5 shuffle+SMJ comparator (join stage only); "
                         "co-partitioned scans must plan zero exchanges"}}
                if edist else {}),
+            **({"engine_aqe": {
+                "balanced_s": round(eaqe["balanced_s"], 3),
+                "skewed_s": round(eaqe["skewed_s"], 3),
+                "skew_ratio": round(eaqe["skew_ratio"], 3)
+                if eaqe["skew_ratio"] else None,
+                "first_s": round(eaqe["first_s"], 3),
+                "rerun_s": round(eaqe["rerun_s"], 3),
+                "rerun_vs_first": round(eaqe["rerun_vs_first"], 3)
+                if eaqe["rerun_vs_first"] else None,
+                "skew": eaqe["skew"],
+                "warm": eaqe["warm"],
+                "note": "SRJT_AQE=1 runtime rewrites on the 8-device CPU "
+                        "mesh: skew_ratio is the skewed twin vs its "
+                        "balanced twin (hot keys split + re-dealt at the "
+                        "exchange, ~1.0 means the split erased the hot "
+                        "device); rerun_vs_first is run 2 of the same "
+                        "source fingerprint planned from run 1's measured "
+                        "build actuals (profile history) vs the cold run "
+                        "(<1.0 means warming won)"}}
+               if eaqe else {}),
             "metrics_snapshot": _metrics_snapshot(),
         },
     }))

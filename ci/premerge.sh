@@ -131,6 +131,31 @@ mo = [s for s in snaps if s.get("metric") == "metrics_overhead"]
 assert mo and mo[0]["ok"], "metrics_overhead line missing or not ok"
 print("metrics overhead: on/off ratio %s (report-only gate key)" %
       mo[0]["ratios"]["on_vs_off"])
+# adaptive execution (docs/ENGINE.md "Adaptive execution"): the skewed
+# smoke run must have APPLIED at least one verified skew split, the
+# post-split engine.exchange.skew gauge must sit under the trigger
+# threshold (the re-deal provably flattened the hot device), and the
+# repeat query must have planned run 2 from run 1s measured actuals
+# (adaptive:history_warmed -> broadcast) and beaten the cold run — all
+# with bit-parity against the AQE-off plans.  The wall-clock ratios
+# (aqe.skew_ratio / aqe.rerun_vs_first) stay report-only in the gate
+# below; this block asserts the structure.
+aqe = [s for s in snaps if s.get("metric") == "aqe"]
+assert aqe, "bench.py --smoke emitted no aqe line"
+assert aqe[0]["ok"], "aqe line not ok: %r" % aqe[0]
+sk, wm = aqe[0]["skew"], aqe[0]["warm"]
+assert sk["splits_applied"] >= 1, "no adaptive:skew_split applied: %r" % sk
+assert sk["gauge_skew"] is not None \
+    and sk["gauge_skew"] < sk["threshold"], \
+    "post-split skew gauge not under threshold: %r" % sk
+assert sk["parity"] and wm["parity"], "AQE parity failed: %r" % aqe[0]
+assert wm["warmed_entries"] >= 1 and wm["run2_broadcast_planned"], \
+    "history warming did not replan run 2: %r" % wm
+print("aqe: %d skew split(s) applied, skew %.2f -> gauge %.2f "
+      "(threshold %.1f); warmed rerun planned broadcast, "
+      "rerun_vs_first %s" % (sk["splits_applied"], sk["pre_skew"],
+                             sk["gauge_skew"], sk["threshold"],
+                             aqe[0]["rerun_vs_first"]))
 '
 
 # Prometheus exposition: one local scrape through tools/srjt_export.py,

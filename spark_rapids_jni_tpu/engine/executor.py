@@ -118,7 +118,7 @@ def new_stats() -> dict:
     return {"row_groups_pruned": 0, "row_groups_read": 0,
             "chunks": 0, "streamed": False, "nodes": 0,
             "fused_segments": 0, "pipelined": False, "topk": False,
-            "exchanges": 0}
+            "exchanges": 0, "aqe_flips": 0, "aqe_splits": 0}
 
 
 # -- execution context -----------------------------------------------------
@@ -132,13 +132,18 @@ class _ExecCtx:
     and stages chunk k+1..k+prefetch while chunk k computes (0 = serial).
     ``recovery``: the query's RecoveryPolicy (retry/degradation ladder +
     cancellation token), checked at every chunk boundary.
+    ``root``: the plan being executed — the adaptive layer needs it for
+    node paths, ledger appends, and RewriteChecker runs on runtime
+    rewrites (engine/adaptive.py).
     """
 
-    __slots__ = ("fuse", "prefetch", "nparents", "segments", "recovery")
+    __slots__ = ("fuse", "prefetch", "nparents", "segments", "recovery",
+                 "root")
 
     def __init__(self, root: PlanNode, fuse: bool, prefetch: int,
                  recovery: Optional[RecoveryPolicy] = None):
         from .segment import parent_counts
+        self.root = root
         self.fuse = fuse
         self.prefetch = max(0, int(prefetch))
         self.nparents = parent_counts(root) if fuse else {}
@@ -391,16 +396,28 @@ def _exec_exchange(node: Exchange, memo: dict, stats: dict,
     stats["exchanges"] += 1
     if node.kind == "broadcast":
         return _broadcast_exchange(node, child)
+    if getattr(node, "_aqe_flip", False):
+        from ..utils.config import config
+        if config.aqe:
+            # AQE rule 1 (engine/adaptive.py): the build side is already
+            # materialized, so its TRUE row count is known before the
+            # shuffle runs — flip the planned hash exchange to broadcast
+            # when it lands under the runtime threshold.  The Exchange
+            # NODE stays the same object (census, spans, and ledger paths
+            # all keyed on it); only the physical op changes.
+            from . import adaptive
+            if adaptive.try_broadcast_flip(node, child, ctx.root, stats):
+                return _broadcast_exchange(node, child)
     rp = ctx.recovery
     try:
         return rp.retry("exchange.dispatch",
-                        lambda: _hash_exchange(node, child, ctx))
+                        lambda: _hash_exchange(node, child, ctx, stats))
     except Exception as e:
         if not rp.can_degrade(e):
             raise
         rp.degrade("exchange-halved", e, stats)
     try:
-        return _hash_exchange(node, child, ctx,
+        return _hash_exchange(node, child, ctx, stats,
                               chunk_rows=_EXCHANGE_CHUNK_ROWS // 2)
     except Exception as e:
         if not rp.can_degrade(e):
@@ -428,9 +445,16 @@ def _broadcast_exchange(node: Exchange, table: Table) -> Table:
         qm.node_add(id(node), node_label(node), wire_bytes=wire)
         # a replicate is structurally balanced: every device receives the
         # whole build side, so the skew columns render 1.0 by construction
+        # — but the REPLICATION itself is the cost (ndev-1 copies of the
+        # build cross the wire), so replica_bytes reports it where skew
+        # cannot: the AQE flip rule and the profile store read it to see
+        # broadcast cost, not just shuffle skew
         qm.node_set(id(node), node_label(node), skew=1.0,
                     straggler_share=0.0, max_dev_rows=table.num_rows,
-                    dev_rows=[table.num_rows] * ndev)
+                    dev_rows=[table.num_rows] * ndev,
+                    replica_bytes=wire)
+    if metrics.enabled():
+        metrics.gauge_set("engine.exchange.replica_bytes", float(wire))
     if ndev <= 1:
         return table
     with timeline.span("engine.exchange.broadcast",
@@ -439,6 +463,7 @@ def _broadcast_exchange(node: Exchange, table: Table) -> Table:
 
 
 def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
+                   stats: Optional[dict] = None,
                    chunk_rows: int = _EXCHANGE_CHUNK_ROWS) -> Table:
     """Streamed two-phase hash shuffle of ``table`` over the full mesh.
 
@@ -446,8 +471,8 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
     ``shuffle_chunks_pipelined`` (dispatch-ahead overlap keyed to the
     engine's prefetch depth).  Exactly two deliberate host syncs per
     exchange, matching ``verify.sync_budget``: one counts-sizing fetch
-    (phase 1 — global when multi-chunk so ONE compiled program serves the
-    stream, inside ``shuffle_table_padded`` when single-chunk) and one
+    (phase 1 — global when multi-chunk OR when the AQE skew rule needs
+    the whole matrix, inside ``shuffle_table_padded`` otherwise) and one
     ok-mask compaction fetch at the end.
     """
     import jax
@@ -492,21 +517,51 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
         live = jax.device_put(jnp.arange(padded.num_rows) < n, row_spec)
         return shard_table(padded, mesh), live
 
+    aqe_split = False
+    if getattr(node, "_aqe_split", False):
+        from ..utils.config import config
+        aqe_split = bool(config.aqe)
+    if stats is None:
+        stats = new_stats()  # direct callers without a query stats dict
+    split = split_entry = None
+    combine = False
+
     capacity = None
-    if nchunks > 1:
+    counts = None
+    if nchunks > 1 or aqe_split:
         # phase 1 once, globally, so one counts sync sizes one compiled
-        # shuffle program for the entire stream.  A chunk's contiguous
-        # shard can straddle one whole-table shard boundary (chunk shards
-        # are never longer than table shards), so its per-(src, dest)
-        # count is bounded by the SUM of two adjacent whole-table pair
-        # counts — size the shared capacity at 2x the global max (one
-        # power-of-two bucket up), which that bound can never exceed
+        # shuffle program for the entire stream (the AQE skew rule also
+        # needs the whole matrix up front, so it hoists this pass even
+        # for a single chunk — same whitelisted sync, same label).  A
+        # chunk's contiguous shard can straddle one whole-table shard
+        # boundary (chunk shards are never longer than table shards), so
+        # its per-(src, dest) count is bounded by the SUM of two adjacent
+        # whole-table pair counts — size the shared capacity at 2x the
+        # global max (one power-of-two bucket up), which that bound can
+        # never exceed
         padded, _ = pad_to_multiple(table, ndev)
         counts = sh.partition_counts(shard_table(padded, mesh), mesh, keys,
                                      n_valid_rows=rows,
                                      key_specs=key_specs)
-        capacity = sh.cap_bucket(2 * int(counts.max()))
         metrics.host_sync(key=id(node), label="exchange-counts-sizing")
+    if aqe_split and counts is not None:
+        # AQE rule 2 (engine/adaptive.py): when the measured matrix shows
+        # skew over SRJT_AQE_SKEW, hot destinations' rows are re-dealt
+        # round-robin inside the shuffle kernel; capacity comes from the
+        # post-split projection instead of the raw max
+        from . import adaptive
+        split, cap_need, split_entry, combine = adaptive.try_skew_split(
+            node, counts, ndev, ctx.root, stats)
+    if counts is not None:
+        if split is not None:
+            # projected per-(src, dest) max post-split; multi-chunk pays
+            # the same straddle bound (two shard pieces, each dealing its
+            # own hot share — at most one extra row per ceil)
+            capacity = sh.cap_bucket(2 * cap_need + 2) if nchunks > 1 \
+                else sh.cap_bucket(cap_need)
+        else:
+            capacity = sh.cap_bucket(2 * int(counts.max())) if nchunks > 1 \
+                else sh.cap_bucket(int(counts.max()))
 
     def chunk_stream():
         for i in range(nchunks):
@@ -521,7 +576,8 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
     with timeline.span("engine.exchange.hash", {"chunks": int(nchunks)}):
         for ci, item in enumerate(sh.shuffle_chunks_pipelined(
                 chunk_stream(), mesh, keys, capacity=capacity,
-                depth=max(1, ctx.prefetch), key_specs=key_specs)):
+                depth=max(1, ctx.prefetch), key_specs=key_specs,
+                split=split)):
             if tl:
                 # flow arrow tails at dispatch — one flow per (chunk,
                 # dest device); heads land on the device lanes at receipt
@@ -601,6 +657,13 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
                         dev_rows=st["dev_rows"],
                         rows_matrix=rows_mat.tolist(),
                         wire_matrix=wire_mat.tolist())
+        if split_entry is not None and split is not None:
+            # the attribution matrix already measured the post-split
+            # placement — fold the proof the split worked into its
+            # ledger entry (EXPLAIN renders measured_skew -> post_skew)
+            from . import adaptive
+            adaptive.update(split_entry, post_skew=st["skew"],
+                            post_straggler_share=st["straggler_share"])
     cols = []
     for dt, ds, vs in zip(table.dtypes(), buf, bufv):
         v = np.concatenate(vs)
@@ -609,6 +672,14 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
     result = Table(cols, table.names)
     if plan is not None:
         result = reassemble_strings(result, plan)
+    if split is not None and combine:
+        # AQE rule 2, merge half: the split scattered each hot key's rows
+        # across devices, so re-combine per key over the merged output —
+        # verified sound by try_skew_split (self-composable ops only)
+        from . import adaptive
+        result, did = adaptive.apply_precombine(node, result)
+        if did:
+            adaptive.update(split_entry, combined_rows=int(result.num_rows))
     return result
 
 
@@ -1072,6 +1143,12 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
                    prefetch=config.prefetch if prefetch is None
                    else int(prefetch),
                    recovery=recovery)
+    if config.aqe:
+        # a cached optimized plan is re-executed object-identical: strip
+        # the PREVIOUS run's adaptive ledger entries before this run
+        # appends its own (ledger==census fuzz invariant)
+        from . import adaptive
+        adaptive.reset(plan)
     # one QueryMetrics per top-level execute (nested/re-entrant executes
     # attribute into the enclosing query); SRJT_METRICS=0 skips entirely
     with metrics.maybe_query(f"execute:{node_label(plan)}") as qm:
@@ -1084,6 +1161,12 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
             cq = qm if qm is not None else metrics.current()
             if cq is not None and not cq.fingerprint:
                 cq.fingerprint = plan.fingerprint()
+                # the PRE-optimization fingerprint rides along so
+                # profile.history can match runs of the same source plan
+                # even when AQE warming changes the optimized shape
+                sfp = getattr(plan, "_source_fingerprint", "")
+                if sfp and not cq.source_fingerprint:
+                    cq.source_fingerprint = sfp
         try:
             out = _exec(plan, {}, stats, ctx)
         except BaseException as e:

@@ -180,21 +180,54 @@ def _annotate(span: Optional[dict], ceiling: Optional[float] = None,
 
 def _decision_line(d: dict, actuals: dict) -> str:
     """One footer line for one optimizer-ledger entry, scored against the
-    actual rows observed at the decision's node (when it executed)."""
+    actual rows observed at the decision's node (when it executed).
+
+    Runtime (``adaptive:*``) entries render their trigger verdict and the
+    MEASURED value that fired (or declined) them — a flip shows the true
+    build rows against the threshold and hash->broadcast; a skew split
+    shows measured_skew -> post_skew, the proof the re-deal worked; a
+    history-warmed entry shows est_before -> est_rows and the choice the
+    prior run's actuals bought."""
     bits = [d.get("kind", "?")]
     path = d.get("path")
     if path:
         bits.append(f"path={path}")
+    if "triggered" in d:
+        bits.append("triggered=yes" if d.get("triggered") else "triggered=no")
     for k in ("side", "how", "exchange", "inner", "n", "keys", "aggs"):
         v = d.get(k)
         if v not in (None, [], ()):
             bits.append(f"{k}={','.join(map(str, v))}"
                         if isinstance(v, (list, tuple)) else f"{k}={v}")
+    if d.get("before") is not None and d.get("after") is not None:
+        bits.append(f"{d['before']}->{d['after']}")
+    if "measured_rows" in d:
+        bits.append(f"measured_rows={d['measured_rows']}")
+    if "measured_skew" in d:
+        bits.append(f"measured_skew={d['measured_skew']:.2f}")
+    if d.get("post_skew") is not None:
+        bits.append(f"post_skew={d['post_skew']:.2f}")
+    if d.get("hot_devices"):
+        bits.append("hot_devices=" + ",".join(map(str, d["hot_devices"])))
+    if d.get("combine"):
+        bits.append("combine=yes")
+    if d.get("combined_rows") is not None:
+        bits.append(f"combined_rows={d['combined_rows']}")
+    if "est_before" in d:
+        bits.append(f"est_before={d['est_before']}")
     if "est_rows" in d:
         e = d["est_rows"]
         bits.append(f"est_rows={'?' if e is None else e}")
+    if d.get("choice"):
+        bits.append(f"choice={d['choice']}")
+    if d.get("prior_kind"):
+        bits.append(f"prior_kind={d['prior_kind']}")
+    if d.get("runs") is not None:
+        bits.append(f"runs={d['runs']}")
     if "threshold" in d:
         bits.append(f"threshold={d['threshold']}")
+    if d.get("verify_rejected"):
+        bits.append("verify_rejected=yes")
     act = actuals.get(path) if path else None
     if act is not None:
         bits.append(f"actual_rows={act}")

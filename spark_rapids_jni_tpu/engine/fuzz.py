@@ -27,14 +27,18 @@ benches happen to build.  Four pieces:
 
 3. **Differential harness** — ``run_case`` sweeps one plan across the
    flag matrix (interpreted / fused / distributed-shuffle /
-   distributed-broadcast via ``SRJT_FUSE``/``SRJT_DIST``/
-   ``SRJT_TOPK``/``SRJT_BROADCAST_ROWS``), asserting after every
-   variant: ``verify()`` passes on the optimized plan, the stamped
-   decision ledger equals ``verify.decision_census`` (for plans
-   without hand-placed structure), the static exchange census equals
-   the executed counter, the static sync budget stays inside
-   ``SYNC_WHITELIST``, engine variants agree bit-exactly, and all
-   agree with a pandas oracle evaluated over the in-memory frames.
+   distributed-broadcast / distributed-AQE via ``SRJT_FUSE``/
+   ``SRJT_DIST``/``SRJT_TOPK``/``SRJT_BROADCAST_ROWS``/``SRJT_AQE``),
+   asserting after every variant: ``verify()`` passes on the optimized
+   plan, the stamped decision ledger equals ``verify.decision_census``
+   (for plans without hand-placed structure), the static exchange
+   census equals the executed counter, the static sync budget stays
+   inside ``SYNC_WHITELIST``, engine variants agree bit-exactly, and
+   all agree with a pandas oracle evaluated over the in-memory frames.
+   The AQE variant plans every join as a shuffle then lets the runtime
+   rules (engine/adaptive.py) flip/split mid-query — parity proves the
+   rewrites content-exact, and every applied rewrite must match its
+   stats counter with a triggered ledger entry.
 
 4. **Shrinker** — ``shrink`` greedily minimizes a failing plan
    (replace a node by its child, drop filter conjuncts, drop
@@ -512,6 +516,15 @@ VARIANTS = (
      "broadcast_rows": 0},
     {"name": "dist-broadcast", "fuse": True, "distribute": True,
      "broadcast_rows": 1_000_000},
+    # AQE adversary: plan every join as a shuffle (broadcast_rows=0), then
+    # let the runtime rules rewrite mid-query — every eligible build flips
+    # to broadcast (aqe_broadcast_rows) and every measurable skew splits
+    # (aqe_skew at the 1.0 floor).  Parity vs the non-AQE variants asserts
+    # the rewrites are content-exact; the adaptive-ledger check asserts
+    # every applied rewrite left a triggered entry behind
+    {"name": "dist-aqe", "fuse": True, "distribute": True,
+     "broadcast_rows": 0, "aqe": True, "aqe_broadcast_rows": 1_000_000,
+     "aqe_skew": 1.0},
 )
 
 #: extra variants the nightly sweep adds on top of VARIANTS
@@ -649,6 +662,34 @@ def run_case(plan: PlanNode, cat, variants=VARIANTS,
                     "exchange-census", name,
                     f"static census {static_ex} != executed "
                     f"{stats['exchanges']}")
+            if flags.get("aqe"):
+                # runtime rewrites must leave evidence: every applied
+                # flip/split bumped its stats counter AND recorded a
+                # triggered ledger entry — the two move in lockstep or
+                # an adaptive rewrite ran unaccounted.  Structural
+                # entries must still equal the census (adaptive kinds
+                # are runtime-only, outside _STRUCTURAL_KINDS).
+                if not manual:
+                    bad = _check_ledger(opt, dist)
+                    if bad:
+                        raise SoundnessFailure("ledger-census-post-aqe",
+                                               name, bad)
+                rt = [d for d in getattr(opt, "_decisions", ())
+                      if d.get("runtime")]
+                flips = sum(1 for d in rt
+                            if d["kind"] == "adaptive:broadcast_flip"
+                            and d.get("triggered"))
+                splits = sum(1 for d in rt
+                             if d["kind"] == "adaptive:skew_split"
+                             and d.get("triggered"))
+                if flips != stats.get("aqe_flips", 0) \
+                        or splits != stats.get("aqe_splits", 0):
+                    raise SoundnessFailure(
+                        "adaptive-ledger", name,
+                        f"triggered ledger (flips={flips}, "
+                        f"splits={splits}) != stats "
+                        f"(flips={stats.get('aqe_flips', 0)}, "
+                        f"splits={stats.get('aqe_splits', 0)})")
             results.append((name, _as_frame(tbl)))
     base_name, base = results[0]
     for name, frame in results[1:]:

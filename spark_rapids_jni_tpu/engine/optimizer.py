@@ -409,7 +409,7 @@ def _estimate_rows(node: PlanNode, memo: dict) -> Optional[int]:
 
 
 def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
-                    memo: dict, dec: list) -> PlanNode:
+                    memo: dict, dec: list, warm=None) -> PlanNode:
     """Insert the minimal exchanges a distributed Join/Aggregate needs.
 
     Bottom-up so each decision sees the children's (possibly already
@@ -431,11 +431,18 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
       (first/last/collect_list) never distribute: the hash exchange does
       not preserve row order, so the whole subtree stays the original
       single stream and matches single-device results exactly.
+
+    ``warm`` is the AQE profile-history queue (adaptive.history_overrides):
+    each placement-needing Join pops the prior run's measured build actual
+    and plans from it instead of the footer estimate — joins are visited
+    in the same deterministic postorder every run of a source fingerprint,
+    so the queue aligns run 2's joins with run 1's recorded placements.
     """
     if id(node) in memo:
         return memo[id(node)]
     mark = len(dec)  # this subtree's ledger entries start here
-    kids = {f: _plan_exchanges(getattr(node, f), pmemo, est, memo, dec)
+    kids = {f: _plan_exchanges(getattr(node, f), pmemo, est, memo, dec,
+                               warm)
             for f in ("child", "left", "right") if hasattr(node, f)}
     out = rebuild(node, **{k: v for k, v in kids.items()
                            if v is not getattr(node, k)})
@@ -450,6 +457,22 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
             pass  # already co-located
         else:
             rows = _estimate_rows(out.right, est)
+            warmed = None
+            if warm is not None:
+                from . import adaptive
+                hint = adaptive.next_build_actual(warm)
+                if hint is not None and hint.get("actual_rows") is not None:
+                    # AQE rule 3 (engine/adaptive.py): the prior run of
+                    # this source fingerprint MEASURED this build side —
+                    # plan from its actual instead of the footer estimate
+                    warmed = {"kind": "adaptive:history_warmed",
+                              "est_before": rows,
+                              "est_rows": int(hint["actual_rows"]),
+                              "prior_kind": hint.get("prior_kind"),
+                              "runs": warm.get("runs", 1),
+                              "threshold": int(config.broadcast_rows),
+                              "choice": "none"}
+                    rows = int(hint["actual_rows"])
             if out.how in _BROADCAST_HOWS and rows is not None \
                     and rows <= config.broadcast_rows:
                 out = rebuild(out, right=Exchange(out.right,
@@ -457,6 +480,8 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
                 dec.append({"kind": "broadcast", "how": out.how,
                             "est_rows": int(rows),
                             "threshold": int(config.broadcast_rows)})
+                if warmed is not None:
+                    warmed["choice"] = "broadcast"
             elif out.how != "cross":
                 left, right = out.left, out.right
                 if not (lp.kind == "hash"
@@ -476,6 +501,10 @@ def _plan_exchanges(node: PlanNode, pmemo: dict, est: dict,
                                 "est_rows": rows,
                                 "threshold": int(config.broadcast_rows)})
                 out = rebuild(out, left=left, right=right)
+                if warmed is not None:
+                    warmed["choice"] = "shuffle"
+            if warmed is not None:
+                dec.append(warmed)
     elif isinstance(out, Aggregate):
         from .executor import _STREAM_COMBINE
         p = partitioning(out.child, pmemo)
@@ -621,6 +650,11 @@ def optimize(plan: PlanNode,
     if config.verify:
         from .verify import RewriteChecker
         checker = RewriteChecker(plan)
+    # the SOURCE (pre-rewrite) fingerprint keys profile history across
+    # runs: AQE warming exists to CHANGE the optimized shape, so the
+    # optimized fingerprint cannot be the cross-run key.  Computed before
+    # any pass touches the plan; only paid when the store is on.
+    src_fp = plan.fingerprint() if config.profile_dir else None
     schema = _Schema()
     decisions: list = []
     plan = _fuse_topk(plan, {}, decisions)
@@ -634,7 +668,11 @@ def optimize(plan: PlanNode,
         checker.check("push_scan_predicates", plan)
     dist = config.distribute if distribute is None else bool(distribute)
     if dist:
-        plan = _plan_exchanges(plan, {}, {}, {}, decisions)
+        warm = None
+        if config.aqe and src_fp:
+            from . import adaptive
+            warm = adaptive.history_overrides(src_fp)
+        plan = _plan_exchanges(plan, {}, {}, {}, decisions, warm)
         if checker is not None:
             checker.check("plan_exchanges", plan)
     if dist or any(isinstance(n, Exchange) for n in topo_nodes(plan)):
@@ -650,4 +688,11 @@ def optimize(plan: PlanNode,
         from .verify import check_partitioning
         check_partitioning(plan)
     _stamp_evidence(plan, decisions, dist)
+    if src_fp is not None:
+        object.__setattr__(plan, "_source_fingerprint", src_fp)
+    if dist:
+        # the runtime rules' eligibility stamps go on LAST — any later
+        # structural pass would rebuild the nodes and drop them
+        from . import adaptive
+        adaptive.stamp_eligibility(plan)
     return plan

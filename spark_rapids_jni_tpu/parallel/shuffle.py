@@ -300,7 +300,7 @@ def exchange_planes(planes, dest, row_mask, ndev: int, capacity: int,
 @functools.lru_cache(maxsize=64)
 def make_shuffle(mesh: Mesh, layout: RowLayout, key_specs: tuple,
                  capacity: int, axis: str = ROW_AXIS,
-                 donate: bool = False):
+                 donate: bool = False, split: tuple | None = None):
     """Build the jitted shard_map shuffle for a fixed schema.
 
     Returns fn(datas, masks, row_mask) -> (planes_in, ok, overflow): the
@@ -314,12 +314,41 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_specs: tuple,
     overlap, SURVEY §2.3 "PP"): the send buffers reuse the table's HBM, so
     a shuffle's working set is ~1x instead of 2x.  Callers must not touch
     the donated table afterwards.
+
+    ``split`` = ``(hot_dests, salt)`` is the AQE skew-split secondary
+    assignment (engine/adaptive.py): rows hashed to a hot destination are
+    re-dealt round-robin across ALL devices by a salted per-shard running
+    index.  The deal bounds each destination's share of a shard's hot rows
+    at ceil(hot/ndev) — unlike a salted re-hash, an adversarial single-key
+    distribution cannot overflow a counts-projected capacity.  Placement
+    stops being key-deterministic for hot rows, so only consumers that
+    merge the full exchange output (or re-combine per key afterwards) may
+    ask for it.  Static (part of the compile cache key), like capacity.
     """
     ndev = axis_size(mesh, axis)
 
     def shard_fn(datas, masks, row_mask):
         cols = _spec_columns(key_specs, datas, masks)
         dest = partition_ids_specs(cols, key_specs, ndev)
+        if split is not None:
+            hot, salt = split
+            is_hot = dest == jnp.int32(hot[0])
+            for h in hot[1:]:
+                is_hot = is_hot | (dest == jnp.int32(h))
+            if row_mask is not None:
+                # dead (pad) rows must not advance the deal: the capacity
+                # projection counted live rows only
+                is_hot = is_hot & row_mask
+            # stagger the deal start by source shard: shards with few hot
+            # rows would otherwise ALL open at dest salt and re-concentrate
+            # what the split is meant to spread.  The per-(src, dest) bound
+            # is rotation-invariant — still at most ceil(hot_s / ndev)
+            shard_idx = jax.lax.axis_index(axis).astype(jnp.int32)
+            hot_idx = jnp.cumsum(is_hot.astype(jnp.int32)) - 1
+            dest = jnp.where(
+                is_hot,
+                (jnp.int32(salt) + shard_idx + hot_idx) % jnp.int32(ndev),
+                dest)
         planes = _build_planes(layout, datas, masks)
         planes_in, rok, overflow = exchange_planes(planes, dest, row_mask,
                                                    ndev, capacity, axis)
@@ -338,7 +367,8 @@ def make_shuffle(mesh: Mesh, layout: RowLayout, key_specs: tuple,
 def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                          capacity: int | None = None,
                          axis: str = ROW_AXIS, donate: bool = False,
-                         live=None, key_specs: tuple | None = None):
+                         live=None, key_specs: tuple | None = None,
+                         split: tuple | None = None):
     """Shuffle a row-sharded table by key hash.
 
     Returns (padded Table [ndev * ndev * capacity global rows], row mask
@@ -359,8 +389,14 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
     table is ALREADY exploded (the engine exchange explodes once globally
     so every chunk shares one layout) — overrides the local computation so
     string keys still hash Spark-exactly.
+
+    ``split``: AQE skew-split ``(hot_dests, salt)`` — see ``make_shuffle``.
+    The internal counts pass sizes capacity for the UNSPLIT placement, so
+    splitting callers must pass the projected capacity explicitly.
     """
     from ..ops.row_conversion import fixed_width_layout
+    if split is not None and capacity is None:
+        raise ValueError("split requires an explicitly projected capacity")
     plan = None
     if any(c.dtype.is_string for c in table.columns):
         names0 = table.names or [f"c{i}" for i in range(table.num_columns)]
@@ -390,7 +426,8 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
                               st["max_dev_rows"])
             for r in st["dev_rows"]:
                 metrics.observe("parallel.shuffle.dev_rows", r)
-    fn = make_shuffle(mesh, layout, key_specs, capacity, axis, donate)
+    fn = make_shuffle(mesh, layout, key_specs, capacity, axis, donate,
+                      split)
     # exchange observability: every slot of the padded all_to_all crosses
     # the interconnect whether live or not, so slots x row_size IS the
     # wire traffic (the padding_efficiency ratio bench.py reports)
@@ -417,7 +454,8 @@ def shuffle_table_padded(table: Table, mesh: Mesh, keys: list,
 def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
                              capacity: int | None = None, depth: int = 1,
                              axis: str = ROW_AXIS, donate: bool = False,
-                             key_specs: tuple | None = None):
+                             key_specs: tuple | None = None,
+                             split: tuple | None = None):
     """Exchange a stream of table chunks with dispatch-ahead overlap.
 
     The engine's double-buffered chunk pipeline applied to the shuffle
@@ -438,7 +476,8 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
     buffer donation: each chunk's send buffers reuse its table's HBM (1x
     working set) — callers must not touch a chunk after yielding it.
     ``key_specs`` passes through to ``shuffle_table_padded`` for streams of
-    already-exploded chunks (Spark-exact string-key placement).
+    already-exploded chunks (Spark-exact string-key placement).  ``split``
+    passes through the AQE skew-split assignment (requires ``capacity``).
 
     Yields ``(padded Table, ok mask, overflow)`` per chunk, in order.
     """
@@ -449,7 +488,7 @@ def shuffle_chunks_pipelined(chunks, mesh: Mesh, keys: list,
         faults.check("exchange.dispatch")
         out = shuffle_table_padded(tbl, mesh, list(keys), capacity=capacity,
                                    axis=axis, donate=donate, live=live,
-                                   key_specs=key_specs)
+                                   key_specs=key_specs, split=split)
         inflight.append(out)
         # dispatch-ahead depth: how many exchanges sit in the device queue
         # in front of the consumer (the pipeline's high-water mark)

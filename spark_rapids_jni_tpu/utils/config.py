@@ -25,6 +25,9 @@ environment flags read once at import:
 | ``SRJT_VERIFY``       | ``1``   | static plan verification in optimize()/PLAN_EXECUTE (engine/verify.py) |
 | ``SRJT_DIST``         | ``0``   | partitioning-aware distributed planning (Exchange placement rules) |
 | ``SRJT_BROADCAST_ROWS`` | ``100000`` | broadcast-join threshold: estimated build rows at or under this replicate instead of shuffling |
+| ``SRJT_AQE``          | ``0``   | adaptive query execution (engine/adaptive.py): runtime broadcast flip, hot-key skew split, profile-warmed planning |
+| ``SRJT_AQE_SKEW``     | ``4.0`` | skew (max/mean device load) above which a hash exchange splits its hot keys round-robin |
+| ``SRJT_AQE_BROADCAST_ROWS`` | ``-1`` | measured-rows threshold for the runtime broadcast flip (``-1`` = follow ``SRJT_BROADCAST_ROWS``) |
 | ``SRJT_PROFILE_DIR``  | *(unset)* | persist one compact query profile JSON per query into this dir (utils/profile.py; empty = off) |
 | ``SRJT_PROFILE_CAP``  | ``512`` | on-disk profile ring capacity (oldest profiles pruned past this) |
 | ``SRJT_FAULTS``       | *(unset)* | deterministic fault injection spec ``site:nth[:kind],...`` (utils/faults.py; empty = all seams no-op) |
@@ -96,6 +99,10 @@ class Config:
     verify: bool = True          # static plan verification (engine/verify.py)
     distribute: bool = False     # Exchange-placement distributed planning
     broadcast_rows: int = 100_000  # broadcast-join build-size threshold (rows)
+    aqe: bool = False            # adaptive execution (engine/adaptive.py)
+    aqe_skew: float = 4.0        # skew threshold for the hot-key split
+    aqe_broadcast_rows: int = -1  # runtime flip threshold (-1 = follow
+    #                               broadcast_rows)
     profile_dir: str = ""        # query-profile store dir (empty = off)
     profile_cap: int = 512       # profile-store ring capacity (files)
     faults: str = ""             # fault-injection spec (utils/faults.py)
@@ -129,6 +136,10 @@ class Config:
             verify=_bool_flag("SRJT_VERIFY", True),
             distribute=_bool_flag("SRJT_DIST", False),
             broadcast_rows=_int_flag("SRJT_BROADCAST_ROWS", 100_000),
+            aqe=_bool_flag("SRJT_AQE", False),
+            aqe_skew=_float_flag("SRJT_AQE_SKEW", 4.0, minimum=1.0),
+            aqe_broadcast_rows=_int_flag("SRJT_AQE_BROADCAST_ROWS", -1,
+                                         minimum=-1),
             profile_dir=os.environ.get("SRJT_PROFILE_DIR", "").strip(),
             profile_cap=_int_flag("SRJT_PROFILE_CAP", 512, minimum=1),
             faults=os.environ.get("SRJT_FAULTS", "").strip(),

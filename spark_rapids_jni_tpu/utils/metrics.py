@@ -179,8 +179,8 @@ class QueryMetrics:
 
     __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
                  "node_spans", "hists", "timers", "mem", "fingerprint",
-                 "outcome", "degradations", "decisions", "progress",
-                 "_lock")
+                 "source_fingerprint", "outcome", "degradations",
+                 "decisions", "progress", "_lock")
 
     def __init__(self, name: str = ""):
         self.qid = next(_qids)
@@ -194,6 +194,9 @@ class QueryMetrics:
         self.timers: dict[str, float] = {}
         self.mem: dict = {}  # device-memory telemetry (mem_sample)
         self.fingerprint: str = ""  # plan fingerprint (profile-store key)
+        # pre-optimization fingerprint (AQE profile-history key: stable
+        # across runs even when warming changes the optimized shape)
+        self.source_fingerprint: str = ""
         self.outcome: dict = {}  # status/kind/error (engine/recovery.py)
         self.degradations: list = []  # ladder steps taken (step, cause)
         self.decisions: list = []  # optimizer ledger (plan._decisions)
@@ -339,6 +342,8 @@ class QueryMetrics:
                    "nodes": nodes}
             if self.fingerprint:
                 out["fingerprint"] = self.fingerprint
+            if self.source_fingerprint:
+                out["source_fingerprint"] = self.source_fingerprint
             if self.mem:
                 out["memory"] = dict(self.mem)
             if self.outcome:

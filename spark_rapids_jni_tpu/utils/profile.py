@@ -110,9 +110,14 @@ def compact(summary: dict) -> dict:
                 "skew": r.get("skew"),
                 "straggler_share": r.get("straggler_share"),
                 "max_dev_rows": r.get("max_dev_rows"),
-                "dev_rows": list(r.get("dev_rows") or ())})
+                "dev_rows": list(r.get("dev_rows") or ()),
+                # broadcast exchanges are structurally balanced (skew 1.0)
+                # but pay ndev-1 replicas of the build — the AQE rules
+                # read the replication cost from here
+                "replica_bytes": r.get("replica_bytes")})
     prof = {"version": VERSION,
             "fingerprint": summary.get("fingerprint", ""),
+            "source_fingerprint": summary.get("source_fingerprint", ""),
             "qid": summary.get("qid"),
             "name": summary.get("name", ""),
             "wall_s": summary.get("wall_s"),
@@ -209,6 +214,42 @@ def latest(fingerprint: str | None = None,
         if fingerprint is None or prof.get("fingerprint") == fingerprint:
             return prof
     return None
+
+
+def history(source_fingerprint: str | None,
+            dir_path: str | None = None) -> dict | None:
+    """Measured history for one SOURCE plan fingerprint — the AQE
+    profile-warming lookup (``optimize()`` consults this on every run
+    when SRJT_AQE is on).
+
+    Matches on the ``source_fingerprint`` stamped by the optimizer (the
+    pre-rewrite plan), not the optimized fingerprint: warming changes the
+    optimized shape, so only the source is stable across runs.  Returns
+    the NEWEST matching run's scored decision ledger and exchange
+    attribution plus how many stored runs matched, or None when the store
+    holds no prior run (torn/unreadable profiles are skipped, exactly
+    like the pruner's concurrent-reader tolerance).
+    """
+    if not source_fingerprint:
+        return None
+    runs = 0
+    newest = None
+    for p in list_profiles(dir_path):
+        try:
+            prof = read(p)
+        except (OSError, ValueError):
+            continue
+        if prof.get("source_fingerprint") == source_fingerprint:
+            runs += 1
+            newest = prof  # list_profiles is oldest-first
+    if newest is None:
+        return None
+    return {"source_fingerprint": source_fingerprint,
+            "fingerprint": newest.get("fingerprint", ""),
+            "runs": runs,
+            "wall_s": newest.get("wall_s"),
+            "decisions": list(newest.get("decisions") or ()),
+            "exchanges": list(newest.get("exchanges") or ())}
 
 
 def store_summary(dir_path: str | None = None) -> dict:

@@ -136,6 +136,11 @@ def cmd_decisions(args) -> int:
         bits = [d.get("kind", "?")]
         if d.get("path"):
             bits.append(f"path={d['path']}")
+        if "triggered" in d:
+            # adaptive (runtime) entry: show the verdict and the measured
+            # value that fired or declined it, then before -> after
+            bits.append("triggered=yes" if d.get("triggered")
+                        else "triggered=no")
         for k in ("side", "how", "exchange", "inner", "n"):
             if d.get(k) is not None:
                 bits.append(f"{k}={d[k]}")
@@ -143,8 +148,27 @@ def cmd_decisions(args) -> int:
             bits.append("keys=" + ",".join(map(str, d["keys"])))
         if d.get("aggs"):
             bits.append("aggs=" + ",".join(map(str, d["aggs"])))
+        if d.get("before") is not None and d.get("after") is not None:
+            bits.append(f"{d['before']}->{d['after']}")
+        if "measured_rows" in d:
+            bits.append(f"measured_rows={d['measured_rows']}")
+        if "measured_skew" in d:
+            bits.append(f"measured_skew={d['measured_skew']:.2f}")
+        if d.get("post_skew") is not None:
+            bits.append(f"post_skew={d['post_skew']:.2f}")
+        if d.get("hot_devices"):
+            bits.append("hot_devices=" + ",".join(map(str,
+                                                      d["hot_devices"])))
+        if d.get("combined_rows") is not None:
+            bits.append(f"combined_rows={d['combined_rows']}")
+        if "est_before" in d:
+            bits.append(f"est_before={d['est_before']}")
         if "est_rows" in d:
             bits.append(f"est={d['est_rows'] if d['est_rows'] is not None else '?'}")
+        if d.get("choice"):
+            bits.append(f"choice={d['choice']}")
+        if d.get("prior_kind"):
+            bits.append(f"prior_kind={d['prior_kind']}")
         if d.get("threshold") is not None:
             bits.append(f"threshold={d['threshold']}")
         if "actual_rows" in d:
@@ -152,6 +176,8 @@ def cmd_decisions(args) -> int:
         if d.get("q_error") is not None:
             bits.append(f"q_error={d['q_error']:.2f}")
         flag = "  ! MISESTIMATE" if d.get("misestimate") else ""
+        if d.get("verify_rejected"):
+            flag += "  ! VERIFY_REJECTED"
         print("  " + " ".join(bits) + flag)
     return 0
 
