@@ -509,8 +509,13 @@ print(json.dumps({{"dist_mrows_s": nl / dt_d / 1e6,
                    "padding_efficiency": pad_eff,
                    "rows_out": drows}}))
 """
+    # hand the bench run's trace to the child (SRJT_TRACE_ID): its flight
+    # recorder, timeline, and any post-mortem bundle join the parent's id
+    from spark_rapids_jni_tpu.utils import blackbox
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
+               SRJT_TRACE_ID=(blackbox.current_trace()
+                              or blackbox.new_trace_id()),
                XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
                           + " --xla_force_host_platform_device_count=8"),
                JAX_ENABLE_X64="1")
@@ -1571,8 +1576,41 @@ def smoke():
                       },
                       "ratios": {"on_vs_off": round(ov_ratio, 4)
                                  if ov_ratio else None}}))
+    # flight-recorder overhead line: the always-on blackbox ring's price —
+    # the same aggregate timed under SRJT_BLACKBOX=0 and =1 (happy path:
+    # ring appends only, no bundle is ever cut).  Report-only like
+    # metrics_overhead; the line exists so a regression in the record()
+    # fast path (utils/blackbox.py) shows up in the bench artifact.
+    prev_bb = os.environ.get("SRJT_BLACKBOX")
+    bb_ms = {}
+    try:
+        for flag in ("0", "1"):
+            os.environ["SRJT_BLACKBOX"] = flag
+            _refresh()
+            execute(ov_opt, new_stats())  # warm (compile)
+            t0 = _time.perf_counter()
+            for _ in range(3):
+                with metrics.query("bb_overhead"):
+                    execute(ov_opt, new_stats())
+            bb_ms[flag] = (_time.perf_counter() - t0) * 1e3 / 3
+    finally:
+        if prev_bb is None:
+            os.environ.pop("SRJT_BLACKBOX", None)
+        else:
+            os.environ["SRJT_BLACKBOX"] = prev_bb
+        _refresh()
+    bb_ratio = (bb_ms["1"] / bb_ms["0"]) if bb_ms.get("0") else None
+    bok = bool(bb_ratio and bb_ratio > 0)
+    print(json.dumps({"metric": "blackbox_overhead",
+                      "ok": bok,
+                      "latency_ms": {
+                          "blackbox_off": round(bb_ms.get("0", 0.0), 3),
+                          "blackbox_on": round(bb_ms.get("1", 0.0), 3),
+                      },
+                      "ratios": {"on_vs_off": round(bb_ratio, 4)
+                                 if bb_ratio else None}}))
     return 0 if (ok and jok and mok and tok and dok and aok and pok
-                 and vok) else 1
+                 and vok and bok) else 1
 
 
 def main():

@@ -17,6 +17,12 @@ whole script runs under ``timeout`` in ci/nightly.sh), a result mismatch,
 a leaked prefetch thread (``io.prefetch.reap_timeouts`` must stay 0), or
 an orphaned spill file.
 
+The flight recorder (utils/blackbox.py) is held to the same oracle: a
+typed error must cut EXACTLY one post-mortem bundle whose trace_id
+matches the one the raised exception carries (``e.trace_id``), a parity
+run cuts at most one (the degradation ladder bundles too), the clean
+oracle runs cut none, and the bundle directory stays bounded.
+
 Run directly::
 
     JAX_PLATFORMS=cpu python ci/chaos_soak.py
@@ -93,12 +99,16 @@ def main(argv=None) -> int:
     # nightly `timeout` wrapper SIGKILLs the soak
     os.environ["SRJT_QUERY_TIMEOUT_S"] = "120"
     os.environ["SRJT_RETRY_BACKOFF_S"] = "0.001"
+    # post-mortem bundles: every typed error below must cut exactly one,
+    # joined to the run by trace id (docs/OBSERVABILITY.md)
+    bb_dir = tempfile.mkdtemp(prefix="srjt-chaos-bb-")
+    os.environ["SRJT_BLACKBOX_DIR"] = bb_dir
 
     import numpy as np
 
     import bench
     from spark_rapids_jni_tpu.engine import execute, optimize
-    from spark_rapids_jni_tpu.utils import errors, faults, tracing
+    from spark_rapids_jni_tpu.utils import blackbox, errors, faults, tracing
     from spark_rapids_jni_tpu.utils.config import refresh
 
     refresh()
@@ -113,6 +123,10 @@ def main(argv=None) -> int:
     thread_floor = threading.active_count()
 
     failures: list[str] = []
+    # fault-free runs must not post-mortem anything
+    if os.listdir(bb_dir):
+        failures.append(
+            f"clean oracle runs cut bundle(s): {os.listdir(bb_dir)}")
     runs = outcomes_parity = outcomes_typed = 0
     t_start = time.monotonic()
     for rnd in range(args.rounds):
@@ -123,18 +137,39 @@ def main(argv=None) -> int:
                 faults.reset()
                 runs += 1
                 tag = f"round{rnd} [{spec}] {name}"
+                before = set(os.listdir(bb_dir))
                 try:
                     out = execute(opt)
                 except Exception as e:  # noqa: BLE001 — the soak classifies
                     kind, _ = errors.classify(e)
+                    fresh = sorted(set(os.listdir(bb_dir)) - before)
                     if kind == errors.KIND_FATAL:
                         failures.append(
                             f"{tag}: FATAL {type(e).__name__}: {e}")
                     else:
                         outcomes_typed += 1
+                        tid = getattr(e, "trace_id", "")
+                        if len(fresh) != 1:
+                            failures.append(
+                                f"{tag}: typed error cut {len(fresh)} "
+                                f"bundle(s), want exactly 1: {fresh}")
+                        else:
+                            doc = blackbox.read_bundle(
+                                os.path.join(bb_dir, fresh[0]))
+                            if not tid or doc.get("trace_id") != tid:
+                                failures.append(
+                                    f"{tag}: bundle trace "
+                                    f"{doc.get('trace_id')!r} != "
+                                    f"client-observed {tid!r}")
                         print(f"  {tag}: typed error "
-                              f"({kind}) {type(e).__name__}")
+                              f"({kind}) {type(e).__name__} "
+                              f"trace={tid[:12] or '?'}")
                     continue
+                fresh = sorted(set(os.listdir(bb_dir)) - before)
+                if len(fresh) > 1:  # 0 ok; 1 = degradation post-mortem
+                    failures.append(
+                        f"{tag}: parity run cut {len(fresh)} bundles: "
+                        f"{fresh}")
                 if _parity(oracle[name], out, key):
                     outcomes_parity += 1
                 else:
@@ -181,10 +216,16 @@ def main(argv=None) -> int:
         names = [t.name for t in threading.enumerate()]
         failures.append(f"{leaked} leaked thread(s): {names}")
 
+    # bundle-dir bound: the writer prunes to its on-disk ring size
+    n_bundles = len(blackbox.list_bundles(bb_dir))
+    if n_bundles > blackbox._DIR_KEEP:
+        failures.append(f"bundle dir unbounded: {n_bundles} files "
+                        f"(cap {blackbox._DIR_KEEP})")
+
     wall = time.monotonic() - t_start
     print(f"chaos soak: {runs} runs in {wall:.1f}s — "
           f"{outcomes_parity} parity, {outcomes_typed} typed errors, "
-          f"{len(failures)} failure(s)")
+          f"{n_bundles} bundle(s), {len(failures)} failure(s)")
     counters = tracing.counters_snapshot("engine.")
     for k in sorted(counters):
         if k.startswith(("engine.retries", "engine.degraded",

@@ -131,6 +131,10 @@ mo = [s for s in snaps if s.get("metric") == "metrics_overhead"]
 assert mo and mo[0]["ok"], "metrics_overhead line missing or not ok"
 print("metrics overhead: on/off ratio %s (report-only gate key)" %
       mo[0]["ratios"]["on_vs_off"])
+bo = [s for s in snaps if s.get("metric") == "blackbox_overhead"]
+assert bo and bo[0]["ok"], "blackbox_overhead line missing or not ok"
+print("blackbox overhead: on/off ratio %s (report-only gate key)" %
+      bo[0]["ratios"]["on_vs_off"])
 # adaptive execution (docs/ENGINE.md "Adaptive execution"): the skewed
 # smoke run must have APPLIED at least one verified skew split, the
 # post-split engine.exchange.skew gauge must sit under the trigger
@@ -192,6 +196,15 @@ python ci/bench_gate.py --artifact target/smoke-artifact.json \
     --profiles target/smoke-profiles \
     --enforce \
     --enforce-keys engine_pipeline_smoke.ratios.fused_vs_interp,engine_join_smoke.ratios.cached_vs_per_chunk
+
+# end-to-end trace join (docs/OBSERVABILITY.md): a clean query's
+# client-minted trace id must reach the server's OP_METRICS summary and
+# the stored profile with zero bundles cut; a fault-injected failing
+# PLAN_EXECUTE must surface a typed client exception whose trace id
+# matches the server's post-mortem bundle (named by the wire error doc)
+# AND the profile-store entry for the failed run — one id across the
+# whole serving path, proven over a real process boundary.
+JAX_PLATFORMS=cpu python ci/trace_join_check.py
 
 # the driver's multi-chip entry must keep compiling + executing
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

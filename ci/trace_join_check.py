@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""End-to-end trace-join check for premerge (docs/OBSERVABILITY.md).
+
+Proves the serving path's observability contract across a REAL process
+boundary — a client in this process, the bridge server in a subprocess —
+twice:
+
+- **clean query**: the client-minted trace id rides the v2 frame into the
+  server, shows up on the server's ``OP_METRICS`` per-query summary AND
+  in the stored profile, and no post-mortem bundle is cut;
+- **fault-injected query** (every parquet chunk read raises ``io_error``
+  until retries exhaust): the typed client exception carries the same
+  trace id as (a) the server's post-mortem bundle, (b) the wire error
+  doc's bundle pointer (``e.bundle_path`` names that exact file), and
+  (c) the profile-store entry for the failed run.
+
+Run directly::
+
+    JAX_PLATFORMS=cpu python ci/trace_join_check.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.bridge.client import BridgeClient, spawn_server
+    from spark_rapids_jni_tpu.engine import Aggregate, Scan
+    from spark_rapids_jni_tpu.utils import blackbox, errors, profile
+
+    root = tempfile.mkdtemp(prefix="srjt-tracejoin-")
+    bb_dir = os.path.join(root, "bundles")
+    prof_dir = os.path.join(root, "profiles")
+    os.makedirs(bb_dir)
+    os.makedirs(prof_dir)
+
+    path = os.path.join(root, "join.parquet")
+    rng = np.random.default_rng(5)
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 16, 4_000).astype(np.int64)),
+        "v": pa.array(rng.uniform(0.0, 1.0, 4_000)),
+    }), path, row_group_size=500)
+    plan = Aggregate(Scan(path, chunk_bytes=1 << 16), ["k"],
+                     [("v", "sum")], names=["s"])
+
+    env = {"SRJT_BLACKBOX_DIR": bb_dir, "SRJT_PROFILE_DIR": prof_dir,
+           "SRJT_METRICS": "1"}
+    failures: list[str] = []
+
+    # -- phase 1: clean query, trace joins client -> server summary/profile
+    sock = os.path.join(root, "bridge.sock")
+    proc = spawn_server(sock, env=env)
+    client = BridgeClient(sock)
+    clean_tid = client.trace_id
+    try:
+        for h in client.execute_plan(plan):
+            client.release(h)
+        queries = (client.metrics() or {}).get("queries") or []
+        hits = [q for q in queries if q.get("trace_id") == clean_tid]
+        if not hits:
+            failures.append(
+                f"no OP_METRICS summary carries client trace {clean_tid!r}: "
+                f"{[q.get('trace_id') for q in queries]}")
+        client.shutdown_server()
+    finally:
+        client.close()
+        proc.wait(timeout=30)
+    if os.listdir(bb_dir):
+        failures.append(
+            f"clean query cut bundle(s): {os.listdir(bb_dir)}")
+    profs = []
+    for p in profile.list_profiles(prof_dir):
+        try:
+            profs.append(profile.read(p))
+        except (OSError, ValueError):
+            continue
+    if not any(pr.get("trace_id") == clean_tid for pr in profs):
+        failures.append(
+            f"no stored profile carries client trace {clean_tid!r}")
+    print(f"trace join (clean): summary+profile matched {clean_tid[:12]}, "
+          f"0 bundles")
+
+    # -- phase 2: injected fault -> typed error + bundle + profile, one id
+    sock2 = os.path.join(root, "bridge2.sock")
+    proc2 = spawn_server(sock2, env={
+        **env, "SRJT_FAULTS": "parquet.chunk:*:io_error",
+        "SRJT_RETRY_BACKOFF_S": "0.001"})
+    client2 = BridgeClient(sock2)
+    fault_tid = client2.trace_id
+    err = None
+    try:
+        try:
+            client2.execute_plan(plan)
+            failures.append("fault-injected plan unexpectedly succeeded")
+        except Exception as e:  # noqa: BLE001 — classified below
+            err = e
+        client2.shutdown_server()
+    finally:
+        client2.close()
+        proc2.wait(timeout=30)
+    if err is not None:
+        kind, _ = errors.classify(err)
+        if kind == errors.KIND_FATAL:
+            failures.append(f"fault surfaced unclassified: "
+                            f"{type(err).__name__}: {err}")
+        tid = getattr(err, "trace_id", "")
+        if tid != fault_tid:
+            failures.append(f"exception trace {tid!r} != client-minted "
+                            f"{fault_tid!r}")
+        matching = []
+        for p in blackbox.list_bundles(bb_dir):
+            try:
+                if blackbox.read_bundle(p).get("trace_id") == fault_tid:
+                    matching.append(p)
+            except (OSError, ValueError):
+                continue
+        if len(matching) != 1:
+            failures.append(f"want exactly 1 bundle for {fault_tid!r}, "
+                            f"got {len(matching)}")
+        bp = getattr(err, "bundle_path", "")
+        if not bp or not matching or \
+                os.path.basename(bp) != os.path.basename(matching[0]):
+            failures.append(f"wire bundle pointer {bp!r} does not name the "
+                            f"matching bundle {matching!r}")
+        fprofs = []
+        for p in profile.list_profiles(prof_dir):
+            try:
+                fprofs.append(profile.read(p))
+            except (OSError, ValueError):
+                continue
+        fhit = [pr for pr in fprofs if pr.get("trace_id") == fault_tid]
+        if not fhit:
+            failures.append(
+                f"no stored profile carries fault trace {fault_tid!r}")
+        elif (fhit[0].get("outcome") or {}).get("status") != "error":
+            failures.append(f"fault profile outcome not error: "
+                            f"{fhit[0].get('outcome')!r}")
+        print(f"trace join (fault): {type(err).__name__} ({kind}) "
+              f"exception==bundle==profile trace {fault_tid[:12]}")
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("trace join check: OK")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,7 +86,9 @@ def _bridge_error(body: bytes) -> Exception:
 
 
 class BridgeClient:
-    def __init__(self, sock_path: str, timeout: float | None = None):
+    def __init__(self, sock_path: str, timeout: float | None = None,
+                 trace_id: str | None = None):
+        from ..utils.blackbox import new_trace_id
         from ..utils.config import config
         # per-op socket deadline: a wedged server can no longer hang the
         # client forever.  None/0 restores the unbounded pre-hardening
@@ -94,6 +96,12 @@ class BridgeClient:
         if timeout is None:
             timeout = config.bridge_timeout_s
         self._timeout = timeout if timeout and timeout > 0 else None
+        # trace context (protocol v2): every frame this client sends
+        # carries this trace_id plus a fresh per-op span_id, so the
+        # server's spans, bundles, and profiles join to this client
+        self.trace_id = trace_id or config.trace_id or new_trace_id()
+        self.last_span_id = ""
+        self._spans = itertools.count(1)
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.settimeout(self._timeout)
         self.sock.connect(sock_path)
@@ -111,14 +119,21 @@ class BridgeClient:
                 "bridge client unusable: a previous op timed out and the "
                 "connection was closed (open a new BridgeClient)")
         self.round_trips += 1
+        # client-side span: sequential within the trace, so the flight
+        # recorder's client events order without clock agreement
+        self.last_span_id = f"{next(self._spans):016x}"
         # PLAN_EXECUTE runs as long as the query does — unbounded by
         # design; SRJT_QUERY_TIMEOUT_S / OP_CANCEL bound it cooperatively.
         # Every other op is a bounded handle exchange and keeps the
         # per-op deadline.
         self.sock.settimeout(None if opcode == P.OP_PLAN_EXECUTE
                              else self._timeout)
+        from ..utils import blackbox
+        blackbox.record("bridge.call", trace=self.trace_id, op=opcode,
+                        span=self.last_span_id)
         try:
-            P.send_msg(self.sock, opcode, payload)
+            P.send_msg(self.sock, opcode, payload,
+                       trace=(self.trace_id, self.last_span_id))
             status, body = P.recv_msg(self.sock)
         except (socket.timeout, P.FrameTimeoutError) as e:
             # the server's late reply may still land on this socket; the
@@ -147,12 +162,15 @@ class BridgeClient:
         self._call(P.OP_SHUTDOWN)
         self.close()
 
-    def cancel(self) -> int:
-        """Flip the cancellation token of every in-flight PLAN_EXECUTE on
-        the server; returns how many were cancelled.  Issue this from a
-        SECOND connection — a connection blocked awaiting its own
-        PLAN_EXECUTE reply cannot also carry the cancel."""
-        (n,) = struct.unpack("<I", self._call(P.OP_CANCEL))
+    def cancel(self, trace_id: str | None = None) -> int:
+        """Flip the cancellation token of in-flight PLAN_EXECUTEs on the
+        server; returns how many were cancelled.  ``trace_id`` cancels
+        only the queries bound to that trace (the concurrent-sessions
+        primitive); None keeps the v1 cancel-everything behavior.  Issue
+        this from a SECOND connection — a connection blocked awaiting its
+        own PLAN_EXECUTE reply cannot also carry the cancel."""
+        payload = trace_id.encode() if trace_id else b""
+        (n,) = struct.unpack("<I", self._call(P.OP_CANCEL, payload))
         return n
 
     # -- handle ops ----------------------------------------------------------
@@ -274,13 +292,16 @@ class BridgeClient:
         import json
         return json.loads(self._call(P.OP_METRICS, prefix.encode()))
 
-    def query_status(self) -> list:
-        """Live progress of every in-flight query on the server (chunks
-        done/total, rows, bytes, ETA).  Like :meth:`cancel`, issue this
-        from a SECOND connection — a connection blocked awaiting its own
-        PLAN_EXECUTE reply cannot also carry the poll."""
+    def query_status(self, trace_id: str | None = None) -> list:
+        """Live progress of in-flight queries on the server (chunks
+        done/total, rows, bytes, ETA) — every query, or only those bound
+        to ``trace_id``.  Like :meth:`cancel`, issue this from a SECOND
+        connection — a connection blocked awaiting its own PLAN_EXECUTE
+        reply cannot also carry the poll."""
         import json
-        return json.loads(self._call(P.OP_QUERY_STATUS))["queries"]
+        payload = trace_id.encode() if trace_id else b""
+        return json.loads(
+            self._call(P.OP_QUERY_STATUS, payload))["queries"]
 
     def live_count(self) -> int:
         (n,) = struct.unpack("<I", self._call(P.OP_LIVE_COUNT))

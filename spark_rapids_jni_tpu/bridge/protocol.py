@@ -5,6 +5,16 @@ Framing (all little-endian):
     request:  [u32 body_len][u8 opcode][payload ...]
     response: [u32 body_len][u8 status][payload ...]   status 0=ok, 1=error
 
+Protocol v2 adds trace propagation: a frame whose first byte has the high
+bit (``TRACE_FLAG``) set carries a 24-byte trace header between the first
+byte and the payload — 16 raw bytes of trace_id + 8 of span_id (hex on the
+Python side).  Opcodes and statuses all fit in 7 bits, so the flag bit is
+free; a v1 peer's frames (flag clear) parse exactly as before, and replies
+mirror the request's version — the server answers an untraced request with
+an untraced reply, so old clients keep working unmodified:
+
+    traced:  [u32 body_len][u8 first_byte|0x80][16B trace][8B span][payload]
+
 On error the payload is a UTF-8 message — the analog of the reference's
 ``CATCH_STD`` exception translation at every JNI entry
 (reference RowConversionJni.cpp:40,65).
@@ -70,15 +80,21 @@ OP_PLAN_EXECUTE = 23   # [u32 plen][plan json utf-8] -> [u32 n][u64 th...]
 #                        serialized engine plan DAG (engine/plan.py
 #                        canonical JSON); the server optimizes/caches/
 #                        executes it and returns result table handle(s)
-OP_CANCEL = 24         # -> [u32 n] flips the cancellation token of every
-#                        in-flight PLAN_EXECUTE on the server (n = how
-#                        many); handled OUTSIDE the dispatch lock, like
-#                        OP_SHUTDOWN, so it can interrupt a running query
-OP_QUERY_STATUS = 25   # -> [json utf-8] live progress of every in-flight
-#                        query ({"queries": metrics.progress_snapshot()}:
-#                        chunks done/total, rows, bytes, ETA); handled
-#                        OUTSIDE the dispatch lock like OP_CANCEL, so a
-#                        second connection can poll a running PLAN_EXECUTE
+OP_CANCEL = 24         # [trace_id hex utf-8, optional] -> [u32 n] flips
+#                        the cancellation token of in-flight PLAN_EXECUTEs
+#                        on the server: every one when the payload is
+#                        empty (v1 behavior), only those bound to the
+#                        given trace_id otherwise.  Handled OUTSIDE the
+#                        dispatch lock, like OP_SHUTDOWN, so it can
+#                        interrupt a running query
+OP_QUERY_STATUS = 25   # [trace_id hex utf-8, optional] -> [json utf-8]
+#                        live progress of in-flight queries ({"queries":
+#                        metrics.progress_snapshot()}: chunks done/total,
+#                        rows, bytes, ETA) — all of them on an empty
+#                        payload (v1 behavior), trace-keyed otherwise;
+#                        handled OUTSIDE the dispatch lock like OP_CANCEL,
+#                        so a second connection can poll a running
+#                        PLAN_EXECUTE
 
 # OP_GROUPBY aggregation codes
 AGG_SUM, AGG_COUNT, AGG_MIN, AGG_MAX, AGG_MEAN = 0, 1, 2, 3, 4
@@ -94,8 +110,17 @@ JOIN_NAMES = {0: "inner", 1: "left", 2: "right", 3: "full", 4: "semi",
 STATUS_OK = 0
 STATUS_ERROR = 1
 
+#: wire protocol version: 2 = trace-header frames (TRACE_FLAG); v1 frames
+#: are still accepted everywhere (flag clear = no trace header)
+PROTOCOL_VERSION = 2
+
+#: high bit of the first byte marks a traced (v2) frame; opcodes and
+#: statuses occupy the low 7 bits only
+TRACE_FLAG = 0x80
+
 _U32 = struct.Struct("<I")
 _HDR = struct.Struct("<IB")  # len + opcode/status
+_TRACE = struct.Struct("<16s8s")  # raw trace_id + span_id bytes
 
 COLDESC = struct.Struct("<iiqBQQQQ")      # typeid, scale, n, hasvalid, 4 bufs
 STRDESC = struct.Struct("<QQ")            # offsets buffer (off, len)
@@ -129,12 +154,33 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def send_msg(sock: socket.socket, first_byte: int, payload: bytes = b"") -> None:
-    sock.sendall(_HDR.pack(1 + len(payload), first_byte) + payload)
+def _trace_bytes(hex_id: str, width: int) -> bytes:
+    """Hex id -> exactly ``width`` raw bytes (zero-padded, truncated)."""
+    try:
+        raw = bytes.fromhex(hex_id)
+    except ValueError:
+        raw = b""
+    return raw[:width].ljust(width, b"\0")
 
 
-def recv_msg(sock: socket.socket) -> tuple[int, bytes]:
-    """Returns (opcode_or_status, payload)."""
+def send_msg(sock: socket.socket, first_byte: int, payload: bytes = b"",
+             trace: tuple[str, str] | None = None) -> None:
+    """Send one frame; ``trace=(trace_id_hex, span_id_hex)`` makes it a v2
+    traced frame (TRACE_FLAG + 24-byte trace header), None a v1 frame."""
+    if trace is None:
+        sock.sendall(_HDR.pack(1 + len(payload), first_byte) + payload)
+        return
+    hdr = _TRACE.pack(_trace_bytes(trace[0], 16), _trace_bytes(trace[1], 8))
+    sock.sendall(_HDR.pack(1 + _TRACE.size + len(payload),
+                           first_byte | TRACE_FLAG) + hdr + payload)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, bytes, str, str]:
+    """Returns (opcode_or_status, payload, trace_id, span_id).
+
+    Accepts both protocol versions: a v1 frame (TRACE_FLAG clear) yields
+    empty trace/span ids; a v2 frame strips the 24-byte trace header and
+    yields both as hex."""
     (body_len,) = _U32.unpack(recv_exact(sock, 4))
     if body_len < 1:
         # a zero-length frame can't carry an opcode; treat the peer as broken
@@ -146,4 +192,18 @@ def recv_msg(sock: socket.socket) -> tuple[int, bytes]:
         # header arrived but the body didn't: mid-message stall, not idle
         raise FrameTimeoutError(
             "bridge frame timed out mid-message") from None
-    return body[0], body[1:]
+    fb = body[0]
+    if not fb & TRACE_FLAG:
+        return fb, body[1:], "", ""
+    if len(body) < 1 + _TRACE.size:
+        raise ConnectionError(
+            "malformed bridge frame (traced frame too short)")
+    tid, sid = _TRACE.unpack_from(body, 1)
+    return (fb & ~TRACE_FLAG, body[1 + _TRACE.size:],
+            tid.hex(), sid.hex())
+
+
+def recv_msg(sock: socket.socket) -> tuple[int, bytes]:
+    """Returns (opcode_or_status, payload); trace header (if any) dropped."""
+    fb, payload, _tid, _sid = recv_frame(sock)
+    return fb, payload

@@ -36,7 +36,7 @@ _COLDESC = P.COLDESC
 _STRDESC = P.STRDESC
 
 
-def _error_body(e: Exception) -> bytes:
+def _error_body(e: Exception, trace_id: str = "", bundle: str = "") -> bytes:
     """STATUS_ERROR payload for one failed op.
 
     Plan-verification failures ship as a JSON document carrying the check
@@ -44,16 +44,22 @@ def _error_body(e: Exception) -> bytes:
     everything else ships the error-taxonomy JSON (kind + retryable bit +
     type + message, utils.errors.to_wire) so the client can reconstruct a
     typed error and its retry layer can tell transient from fatal without
-    string-matching."""
-    from ..engine.verify import PlanVerificationError
-    if isinstance(e, PlanVerificationError):
-        import json
-        return json.dumps({"error": "plan_verification",
-                           **e.to_dict()}).encode()
+    string-matching.  Both shapes carry the trace_id and the post-mortem
+    bundle path (utils/blackbox.py) when known, so a failed call is
+    joinable to server telemetry from the client side alone."""
     import json
 
-    from ..utils import errors
-    return json.dumps(errors.to_wire(e)).encode()
+    from ..engine.verify import PlanVerificationError
+    if isinstance(e, PlanVerificationError):
+        doc = {"error": "plan_verification", **e.to_dict()}
+    else:
+        from ..utils import errors
+        doc = errors.to_wire(e)
+    if trace_id and not doc.get("trace_id"):
+        doc["trace_id"] = trace_id
+    if bundle and not doc.get("bundle"):
+        doc["bundle"] = bundle
+    return json.dumps(doc).encode()
 
 
 class HandleTable:
@@ -153,10 +159,11 @@ class BridgeServer:
         self._conns_lock = threading.Lock()
         self._conns: set[socket.socket] = set()
         # cancellation registry: live CancelTokens of in-flight
-        # PLAN_EXECUTEs; OP_CANCEL (handled outside the dispatch lock)
-        # flips every one of them
+        # PLAN_EXECUTEs, keyed to their query's trace_id; OP_CANCEL
+        # (handled outside the dispatch lock) flips every one of them,
+        # or only the given trace's when the payload names one
         self._tokens_lock = threading.Lock()
-        self._active_tokens: set[object] = set()
+        self._active_tokens: dict[object, str] = {}
         # observability (SURVEY §5 metrics/logging): per-op counters the
         # client reads over OP_METRICS; slf4j-analog logger from utils.config
         self._metrics = {"ops": {}, "errors": 0, "busy_s": 0.0}
@@ -418,7 +425,7 @@ class BridgeServer:
         from ..ops.selection import concat_tables
         return struct.pack("<Q", self.handles.put(concat_tables(tabs)))
 
-    def _op_plan_execute(self, payload: bytes) -> bytes:
+    def _op_plan_execute(self, payload: bytes, trace_id: str = "") -> bytes:
         """Whole-plan dispatch: one message runs a multi-op plan DAG.
 
         The serve-heavy-traffic counterpart to the per-op methods above:
@@ -426,61 +433,74 @@ class BridgeServer:
         plan; the server-side ``PlanCache`` optimizes it once per
         fingerprint (hits skip optimization AND reuse warm jit caches) and
         the executor runs it against local io/ops.  Result table handles
-        come back in the one reply.
+        come back in the one reply.  The whole run executes under the
+        client's trace scope (``trace_id`` from the v2 frame header, or a
+        server-minted one for v1 clients) so server spans, the flight
+        recorder, and any post-mortem bundle all join on the client's id.
         """
         (plen,) = struct.unpack_from("<I", payload)
         blob = payload[4:4 + plen]
         from ..engine import deserialize
-        plan = deserialize(blob)
-        from ..utils.config import config
-        if config.verify:
-            # build-time checks up front: a bad plan (unknown column, join
-            # dtype mismatch, ...) becomes a structured error reply carrying
-            # the check code + node path (_error_body), not an executor
-            # traceback from deep inside a chunk loop
-            from ..engine import verify
-            verify(plan)
-        if self._plan_cache is None:
-            from ..engine import PlanCache
-            self._plan_cache = PlanCache()
-        from ..utils import metrics
-        from ..utils.config import config as _cfg
-        from ..utils.errors import CancelToken
-        stats: dict = {}
-        # per-query cancellation: registered while the plan runs so a
-        # concurrent OP_CANCEL (or the SRJT_QUERY_TIMEOUT_S deadline) can
-        # stop it at the next chunk boundary
-        tok = CancelToken(_cfg.query_timeout_s or None)
-        with self._tokens_lock:
-            self._active_tokens.add(tok)
-        try:
-            # plan-cache lookup runs inside the query context so its
-            # hit/miss is attributed to the query that caused it
-            # (OP_METRICS `queries`)
-            with metrics.query(f"plan:{plan.fingerprint()[:12]}") as qm:
-                compiled = self._plan_cache.get(plan)
-                out = compiled.execute(stats=stats, cancel=tok)
-                if qm is not None:
-                    qm.note_stats(stats)
-        finally:
+        from ..utils import blackbox
+        with blackbox.query_scope(trace_id, label="plan_execute") as scope:
+            plan = deserialize(blob)
+            from ..utils.config import config
+            if config.verify:
+                # build-time checks up front: a bad plan (unknown column,
+                # join dtype mismatch, ...) becomes a structured error reply
+                # carrying the check code + node path (_error_body), not an
+                # executor traceback from deep inside a chunk loop
+                from ..engine import verify
+                verify(plan)
+            if self._plan_cache is None:
+                from ..engine import PlanCache
+                self._plan_cache = PlanCache()
+            from ..utils import metrics
+            from ..utils.config import config as _cfg
+            from ..utils.errors import CancelToken
+            stats: dict = {}
+            # per-query cancellation: registered while the plan runs so a
+            # concurrent OP_CANCEL (or the SRJT_QUERY_TIMEOUT_S deadline)
+            # can stop it at the next chunk boundary — keyed by trace so a
+            # second connection can cancel exactly this query
+            tok = CancelToken(_cfg.query_timeout_s or None)
             with self._tokens_lock:
-                self._active_tokens.discard(tok)
+                self._active_tokens[tok] = scope.trace_id
+            try:
+                # plan-cache lookup runs inside the query context so its
+                # hit/miss is attributed to the query that caused it
+                # (OP_METRICS `queries`)
+                with metrics.query(f"plan:{plan.fingerprint()[:12]}") as qm:
+                    if qm is not None:
+                        qm.trace_id = scope.trace_id
+                    compiled = self._plan_cache.get(plan)
+                    out = compiled.execute(stats=stats, cancel=tok)
+                    if qm is not None:
+                        qm.note_stats(stats)
+            finally:
+                with self._tokens_lock:
+                    self._active_tokens.pop(tok, None)
         self._last_plan_stats = stats
         if qm is not None:
             self._last_plan_summary = qm.summary()
         h = self.handles.put(out)
         return struct.pack("<I", 1) + struct.pack("<Q", h)
 
-    def _cancel_active(self) -> int:
-        """Flip every in-flight PLAN_EXECUTE's token; returns how many."""
+    def _cancel_active(self, trace_id: str = "") -> int:
+        """Flip in-flight PLAN_EXECUTE tokens; returns how many.
+
+        An empty ``trace_id`` flips every one (the v1 empty-payload
+        behavior); otherwise only the tokens registered under that trace."""
         with self._tokens_lock:
-            toks = list(self._active_tokens)
+            toks = [t for t, tid in self._active_tokens.items()
+                    if not trace_id or tid == trace_id]
         for t in toks:
             t.cancel("cancelled via bridge OP_CANCEL")
         return len(toks)
 
     # -- dispatch loop -----------------------------------------------------
-    def _dispatch(self, opcode: int, payload: bytes) -> bytes:
+    def _dispatch(self, opcode: int, payload: bytes,
+                  trace_id: str = "") -> bytes:
         from ..utils import faults
         faults.check("bridge.op")
         if opcode == P.OP_PING:
@@ -528,7 +548,7 @@ class BridgeServer:
         if opcode == P.OP_CONCAT:
             return self._op_concat(payload)
         if opcode == P.OP_PLAN_EXECUTE:
-            return self._op_plan_execute(payload)
+            return self._op_plan_execute(payload, trace_id)
         raise ValueError(f"unknown opcode {opcode}")
 
     def _op_metrics(self, payload: bytes = b"") -> bytes:
@@ -576,6 +596,13 @@ class BridgeServer:
         if timeline.enabled():
             # Chrome trace-event JSON, ready for chrome://tracing/Perfetto
             snap["timeline"] = timeline.export()
+        # flight-recorder health + SLO burn (utils/blackbox.py): the SLO
+        # block is the same shape prometheus_text renders as gauges, so a
+        # JNI-side poller and the exporter agree by construction
+        from ..utils import blackbox
+        snap["blackbox"] = blackbox.ring_stats()
+        if blackbox.slo_enabled():
+            snap["slo"] = blackbox.slo_report()
         return json.dumps(snap).encode()
 
     def serve_forever(self) -> None:
@@ -643,19 +670,28 @@ class BridgeServer:
         with conn:
             while not self._shutdown.is_set():
                 try:
-                    opcode, payload = P.recv_msg(conn)
+                    opcode, payload, tid, span = P.recv_frame(conn)
                 except socket.timeout:
                     continue  # idle connection; re-check shutdown and wait
                 except ConnectionError:
                     return  # client went away; others keep running
+                # replies mirror the request's protocol version: a traced
+                # (v2) request gets a traced reply echoing its ids, a v1
+                # request gets a byte-identical-to-before v1 reply — old
+                # clients keep working unmodified
+                trace = (tid, span) if tid else None
                 if opcode == P.OP_CANCEL:
                     # outside the dispatch lock, like OP_SHUTDOWN: the
                     # whole point is to interrupt a PLAN_EXECUTE that is
-                    # holding that lock right now
-                    n = self._cancel_active()
+                    # holding that lock right now.  Payload = optional
+                    # trace_id hex: empty flips everything (v1 behavior),
+                    # otherwise only that trace's query.
+                    n = self._cancel_active(
+                        payload.decode("utf-8", "replace").strip())
                     self._log.info("OP_CANCEL flipped %d token(s)", n)
                     try:
-                        P.send_msg(conn, P.STATUS_OK, struct.pack("<I", n))
+                        P.send_msg(conn, P.STATUS_OK, struct.pack("<I", n),
+                                   trace=trace)
                     except OSError:  # dead OR slow peer (send deadline)
                         return
                     continue
@@ -663,19 +699,24 @@ class BridgeServer:
                     # outside the dispatch lock, like OP_CANCEL: the point
                     # is to observe a PLAN_EXECUTE that is holding that
                     # lock right now.  Reads only the progress registry's
-                    # host-side dicts — zero device syncs added.
+                    # host-side dicts — zero device syncs added.  Payload =
+                    # optional trace_id hex narrowing to that one query.
                     import json as _json
                     from ..utils import metrics as _metrics
-                    body = _json.dumps(
-                        {"queries": _metrics.progress_snapshot()}).encode()
+                    queries = _metrics.progress_snapshot()
+                    want = payload.decode("utf-8", "replace").strip()
+                    if want:
+                        queries = [q for q in queries
+                                   if q.get("trace_id") == want]
+                    body = _json.dumps({"queries": queries}).encode()
                     try:
-                        P.send_msg(conn, P.STATUS_OK, body)
+                        P.send_msg(conn, P.STATUS_OK, body, trace=trace)
                     except OSError:  # dead OR slow peer (send deadline)
                         return
                     continue
                 if opcode == P.OP_SHUTDOWN:
                     try:
-                        P.send_msg(conn, P.STATUS_OK)
+                        P.send_msg(conn, P.STATUS_OK, trace=trace)
                     except OSError:  # dead OR slow peer (send deadline)
                         pass
                     self._shutdown.set()
@@ -691,7 +732,7 @@ class BridgeServer:
                 try:
                     with self._dispatch_lock:
                         t0 = time.perf_counter()
-                        out = self._dispatch(opcode, payload)
+                        out = self._dispatch(opcode, payload, tid)
                         ops = self._metrics["ops"]
                         ops[opcode] = ops.get(opcode, 0) + 1
                         self._metrics["busy_s"] += time.perf_counter() - t0
@@ -699,11 +740,21 @@ class BridgeServer:
                     self._metrics["errors"] += 1
                     self._log.warning("op %d failed: %s: %s", opcode,
                                       type(e).__name__, e)
-                    status, resp = P.STATUS_ERROR, _error_body(e)
+                    # post-mortem before replying: the executor's own
+                    # bundle (if any) wins via e.bundle_path; otherwise
+                    # this writes one for pre-executor failures (bad plan,
+                    # bad handle) under the client's trace
+                    from ..utils import blackbox
+                    bundle = getattr(e, "bundle_path", "") or \
+                        blackbox.post_mortem(f"bridge.op:{opcode}", exc=e,
+                                             trace_id=tid) or ""
+                    status, resp = P.STATUS_ERROR, _error_body(
+                        e, trace_id=getattr(e, "trace_id", "") or tid,
+                        bundle=bundle)
                 else:
                     status, resp = P.STATUS_OK, out
                 try:
-                    P.send_msg(conn, status, resp)
+                    P.send_msg(conn, status, resp, trace=trace)
                 except OSError:
                     # client died mid-reply, or a slow client tripped the
                     # send deadline (socket.timeout is an OSError): drop

@@ -394,6 +394,8 @@ def _exec_exchange(node: Exchange, memo: dict, stats: dict,
     # executed count always equals the static verify.plan_exchanges census
     # — ci/premerge.sh compares the two on the smoke artifact
     stats["exchanges"] += 1
+    from ..utils import blackbox
+    blackbox.record("exchange", kind=node.kind, rows=child.num_rows)
     if node.kind == "broadcast":
         return _broadcast_exchange(node, child)
     if getattr(node, "_aqe_flip", False):
@@ -1150,8 +1152,16 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
         from . import adaptive
         adaptive.reset(plan)
     # one QueryMetrics per top-level execute (nested/re-entrant executes
-    # attribute into the enclosing query); SRJT_METRICS=0 skips entirely
-    with metrics.maybe_query(f"execute:{node_label(plan)}") as qm:
+    # attribute into the enclosing query); SRJT_METRICS=0 skips entirely.
+    # The blackbox trace scope wraps it: re-entrant the same way, it binds
+    # (or mints) the end-to-end trace_id and feeds the flight recorder —
+    # which stays on even with the metrics layer off.
+    from ..utils import blackbox
+    with blackbox.query_scope(label=f"execute:{node_label(plan)}") as scope, \
+            metrics.maybe_query(f"execute:{node_label(plan)}") as qm:
+        tq = qm if qm is not None else metrics.current()
+        if tq is not None and not tq.trace_id:
+            tq.trace_id = scope.trace_id
         if config.profile_dir:
             # the profile store keys cross-run diffs by plan fingerprint;
             # stamp whichever query context covers this execute — the one
@@ -1175,6 +1185,10 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
             oq = qm if qm is not None else metrics.current()
             if oq is not None:
                 oq.set_outcome("error", kind=kind, error=str(e))
+            # post-mortem bundle (SRJT_BLACKBOX_DIR): outcome is stamped,
+            # so the bundle's query summary already says how it died; the
+            # exception carries trace_id/bundle_path out to the bridge
+            blackbox.post_mortem(f"engine.execute:{kind}", exc=e, qm=oq)
             raise
         oq = qm if qm is not None else metrics.current()
         if oq is not None:

@@ -78,7 +78,13 @@ class RecoveryPolicy:
 
     def degrade(self, step: str, exc: BaseException,
                 stats: Optional[dict] = None) -> None:
-        """Record one ladder step: count, log, stamp query outcome."""
+        """Record one ladder step: count, log, stamp query outcome.
+
+        Also feeds the flight recorder and writes a post-mortem bundle
+        (utils/blackbox.py): a query that gave up capacity is a serving
+        incident worth a durable record even when it ultimately succeeds.
+        Bundle dedup is per query execution, so a degradation followed by
+        more rungs — or the final error — still yields exactly one."""
         kind, _ = classify(exc)
         metrics.count("engine.degraded")
         metrics.count(f"engine.degraded.{step}")
@@ -89,6 +95,10 @@ class RecoveryPolicy:
         qm = metrics.current()
         if qm is not None:
             qm.degrade(step, kind)
+        from ..utils import blackbox
+        blackbox.record("degrade", step=step, kind=kind,
+                        msg=str(exc)[:200])
+        blackbox.post_mortem(f"degrade:{step}", qm=qm)
         logger().warning("degraded (%s) after %s: %s", step, kind, exc)
 
 

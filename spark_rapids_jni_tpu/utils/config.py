@@ -36,6 +36,11 @@ environment flags read once at import:
 | ``SRJT_QUERY_TIMEOUT_S`` | ``0`` | cooperative per-query deadline in seconds (0 = none; checked at chunk boundaries) |
 | ``SRJT_BRIDGE_TIMEOUT_S`` | ``60`` | per-op socket deadline on bridge client+server (0 = block forever, the pre-hardening behavior) |
 | ``SRJT_MEM_DEBUG``    | ``0``   | live-buffer census checkpoints + MemoryScope exit report (io chunked reader, utils/memory.py) |
+| ``SRJT_BLACKBOX``     | ``1``   | always-on flight recorder (utils/blackbox.py): bounded ring of coarse events, independent of SRJT_METRICS/SRJT_TIMELINE |
+| ``SRJT_BLACKBOX_DIR`` | *(unset)* | post-mortem bundle directory (empty = ring only, no disk writes) |
+| ``SRJT_BLACKBOX_CAP`` | ``512`` | flight-recorder ring capacity (events; oldest dropped) |
+| ``SRJT_SLO_MS``       | *(unset)* | latency objectives: ``default_ms[,fp12=ms,...]`` per source fingerprint, evaluated from the profile store (utils/blackbox.py slo_report) |
+| ``SRJT_TRACE_ID``     | *(unset)* | inherited trace context for helper processes (bench dist subprocess); minted per client/query when empty |
 | ``SRJT_ROOFLINE_GBPS`` | ``0`` | device-bandwidth ceiling override for explain-analyze roofline fractions (0 = use BENCH_BASELINES.json pin) |
 | ``JAX_PLATFORMS``     | *(unset)* | jax platform list honored by the bridge server before its first jax touch |
 
@@ -111,6 +116,11 @@ class Config:
     query_timeout_s: float = 0.0   # cooperative query deadline (0 = none)
     bridge_timeout_s: float = 60.0  # bridge per-op socket deadline (0=off)
     mem_debug: bool = False      # live-buffer census + MemoryScope reports
+    blackbox: bool = True        # flight recorder ring (utils/blackbox.py)
+    blackbox_dir: str = ""       # post-mortem bundle dir (empty = no disk)
+    blackbox_cap: int = 512      # flight-recorder ring capacity (events)
+    slo_ms: str = ""             # latency objectives spec (default[,fp=ms])
+    trace_id: str = ""           # inherited trace context (subprocesses)
     roofline_gbps: float = 0.0   # explain-analyze ceiling override (0=pin)
     jax_platforms: str = ""      # jax platform list ("" = jax's default)
 
@@ -148,6 +158,11 @@ class Config:
             query_timeout_s=_float_flag("SRJT_QUERY_TIMEOUT_S", 0.0),
             bridge_timeout_s=_float_flag("SRJT_BRIDGE_TIMEOUT_S", 60.0),
             mem_debug=_bool_flag("SRJT_MEM_DEBUG", False),
+            blackbox=_bool_flag("SRJT_BLACKBOX", True),
+            blackbox_dir=os.environ.get("SRJT_BLACKBOX_DIR", "").strip(),
+            blackbox_cap=_int_flag("SRJT_BLACKBOX_CAP", 512, minimum=16),
+            slo_ms=os.environ.get("SRJT_SLO_MS", "").strip(),
+            trace_id=os.environ.get("SRJT_TRACE_ID", "").strip(),
             roofline_gbps=_float_flag("SRJT_ROOFLINE_GBPS", 0.0),
             jax_platforms=os.environ.get("JAX_PLATFORMS", "").strip(),
         )

@@ -117,10 +117,21 @@ _KIND_FALLBACK = {
 
 
 def to_wire(exc: BaseException) -> dict:
-    """Structured error document (bridge ``_error_body`` payload)."""
+    """Structured error document (bridge ``_error_body`` payload).
+
+    Carries the trace context when the exception has one (stamped by
+    ``utils.blackbox.post_mortem`` on the way out of the executor) so a
+    round-tripped error keeps its join key."""
     kind, retryable = classify(exc)
-    return {"error": "taxonomy", "kind": kind, "retryable": retryable,
-            "type": type(exc).__name__, "msg": str(exc)}
+    doc = {"error": "taxonomy", "kind": kind, "retryable": retryable,
+           "type": type(exc).__name__, "msg": str(exc)}
+    tid = getattr(exc, "trace_id", "")
+    if tid:
+        doc["trace_id"] = tid
+    bundle = getattr(exc, "bundle_path", "")
+    if bundle:
+        doc["bundle"] = bundle
+    return doc
 
 
 def from_wire(doc: dict) -> Exception:
@@ -129,18 +140,28 @@ def from_wire(doc: dict) -> Exception:
     Known engine types rebuild exactly; anything else lands on the
     kind-matched ``EngineError`` subclass (or a plain ``RuntimeError``
     for ``fatal``) with the original type name preserved in the message.
+    The trace context rides along: ``e.trace_id`` joins the failure to
+    the server's spans/profile entry, ``e.bundle_path`` points at its
+    post-mortem bundle (utils/blackbox.py) when one was written.
     """
     kind = doc.get("kind", KIND_FATAL)
     tname = doc.get("type", "")
     msg = doc.get("msg", "")
     cls = _WIRE_TYPES.get(tname)
     if cls is not None:
-        return cls(msg)
-    text = f"{tname}: {msg}" if tname else msg
-    fb = _KIND_FALLBACK.get(kind)
-    if fb is not None:
-        return fb(text)
-    return RuntimeError(f"bridge error: {text}")
+        exc: Exception = cls(msg)
+    else:
+        text = f"{tname}: {msg}" if tname else msg
+        fb = _KIND_FALLBACK.get(kind)
+        exc = fb(text) if fb is not None \
+            else RuntimeError(f"bridge error: {text}")
+    tid = doc.get("trace_id", "")
+    if tid:
+        exc.trace_id = tid
+    bundle = doc.get("bundle", "")
+    if bundle:
+        exc.bundle_path = bundle
+    return exc
 
 
 # -- cooperative cancellation ------------------------------------------------
@@ -224,6 +245,8 @@ def retry_call(fn: Callable, site: str,
             attempt += 1
             metrics.count("engine.retries")
             metrics.count(f"engine.retries.{site}")
+            from . import blackbox
+            blackbox.record("retry", site=site, attempt=attempt, kind=kind)
             # deterministic jitter in [-25%, +25%]: crc32 of site:attempt —
             # stable across processes, unlike hash() under PYTHONHASHSEED
             j = (zlib.crc32(f"{site}:{attempt}".encode()) % 1000) / 1000.0

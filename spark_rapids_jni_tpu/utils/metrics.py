@@ -180,11 +180,15 @@ class QueryMetrics:
     __slots__ = ("qid", "name", "t0", "wall_s", "stats", "counters",
                  "node_spans", "hists", "timers", "mem", "fingerprint",
                  "source_fingerprint", "outcome", "degradations",
-                 "decisions", "progress", "_lock")
+                 "decisions", "progress", "trace_id", "_lock")
 
     def __init__(self, name: str = ""):
         self.qid = next(_qids)
         self.name = name or f"q{self.qid}"
+        # end-to-end trace id (utils/blackbox.py query_scope): stamped by
+        # the bridge server from the client's v2 frame header, so client
+        # spans, server spans, and post-mortem bundles join on one id
+        self.trace_id: str = ""
         self.t0 = time.perf_counter()
         self.wall_s: float | None = None
         self.stats: dict = {}
@@ -344,6 +348,8 @@ class QueryMetrics:
                 out["fingerprint"] = self.fingerprint
             if self.source_fingerprint:
                 out["source_fingerprint"] = self.source_fingerprint
+            if self.trace_id:
+                out["trace_id"] = self.trace_id
             if self.mem:
                 out["memory"] = dict(self.mem)
             if self.outcome:
@@ -470,7 +476,11 @@ def host_sync(n: int = 1, key=None, label: str = "") -> None:
 
     Also drops a timeline instant event at the sync site — timeline-gated
     independently of SRJT_METRICS, so the Perfetto view marks the engine's
-    deliberate syncs even with the metrics layer off."""
+    deliberate syncs even with the metrics layer off — and a flight-
+    recorder event (utils/blackbox.py), which survives even with BOTH
+    observability layers off."""
+    from . import blackbox
+    blackbox.record("host_sync", label=label, n=n)
     if config.timeline:
         timeline.instant("engine.host_sync",
                          {"label": label} if label else None)
@@ -542,6 +552,7 @@ def progress_snapshot() -> list:
             p50 = _hist_percentiles(h, (0.5,))["p50"] if h else None
             entry = {"qid": qm.qid, "name": qm.name,
                      "fingerprint": qm.fingerprint,
+                     "trace_id": qm.trace_id,
                      "wall_s": round(time.perf_counter() - qm.t0, 6),
                      **p}
         remaining = p["chunks_total"] - p["chunks_done"]
@@ -581,12 +592,18 @@ def prometheus_text(snap: dict | None = None, prefix: str = "") -> str:
     remote server's registry; default is this process's live registry.
     Adds ``srjt_queries_in_flight`` and per-query progress gauges from
     the progress registry (local scrapes only — a snapshot dict carries
-    no live progress)."""
+    no live progress), and SLO burn-rate gauges per source fingerprint
+    when objectives are declared (``SRJT_SLO_MS``, utils/blackbox.py) —
+    either from the snapshot's ``slo`` block (an OP_METRICS reply) or
+    evaluated locally from profile-store history."""
     if snap is None:
         snap = {"counters": tracing.counters_snapshot(prefix),
                 "histograms": histograms_snapshot(prefix),
                 "gauges": gauges_snapshot(prefix),
                 "progress": progress_snapshot()}
+        from . import blackbox
+        if blackbox.slo_enabled():
+            snap["slo"] = blackbox.slo_report()
     lines: list[str] = []
     for k in sorted(snap.get("counters") or {}):
         name = _prom_name(k)
@@ -609,6 +626,22 @@ def prometheus_text(snap: dict | None = None, prefix: str = "") -> str:
                 for e in progress:
                     lines.append(f'{name}{{qid="{e["qid"]}",'
                                  f'name="{e["name"]}"}} {e[g]}')
+    slo = snap.get("slo") or {}
+    if slo.get("enabled"):
+        if slo.get("default_ms") is not None:
+            lines.append("# TYPE srjt_slo_default_objective_ms gauge")
+            lines.append("srjt_slo_default_objective_ms "
+                         f"{float(slo['default_ms']):g}")
+        entries = slo.get("entries") or []
+        for g in ("objective_ms", "runs", "breaches", "errors",
+                  "worst_ms", "burn_rate"):
+            if not entries:
+                break
+            name = f"srjt_slo_{g}"
+            lines.append(f"# TYPE {name} gauge")
+            for e in entries:
+                lines.append(f'{name}{{fingerprint="{e["fingerprint"]}"}} '
+                             f"{float(e[g]):g}")
     return "\n".join(lines) + "\n"
 
 

@@ -118,6 +118,7 @@ def compact(summary: dict) -> dict:
     prof = {"version": VERSION,
             "fingerprint": summary.get("fingerprint", ""),
             "source_fingerprint": summary.get("source_fingerprint", ""),
+            "trace_id": summary.get("trace_id", ""),
             "qid": summary.get("qid"),
             "name": summary.get("name", ""),
             "wall_s": summary.get("wall_s"),

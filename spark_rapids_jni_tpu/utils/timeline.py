@@ -110,6 +110,12 @@ def _append(ev: dict, dev: int | None = None) -> None:
     q = _qname()
     if q is not None:
         ev.setdefault("args", {})["query"] = q
+    # end-to-end trace id (utils/blackbox.py): ties timeline slices to
+    # bridge spans and post-mortem bundles across processes
+    from . import blackbox
+    trace = blackbox.current_trace()
+    if trace:
+        ev.setdefault("args", {})["trace"] = trace
     dropped_now = warn = False
     with _lock:
         if tid not in _thread_names:

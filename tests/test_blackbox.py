@@ -1,0 +1,448 @@
+"""Flight recorder, trace propagation, and SLO layer (docs/OBSERVABILITY.md).
+
+The contract under test:
+
+- the recorder ring is always on (independent of SRJT_METRICS), bounded
+  (overflow keeps the newest events), and gated only by SRJT_BLACKBOX;
+- post-mortem bundles are written atomically (a torn write leaves
+  nothing behind), exactly once per query execution, and carry the
+  trace_id the failing exception is stamped with;
+- v2 bridge frames carry the trace across a REAL socket — client spans,
+  server spans, OP_QUERY_STATUS / OP_CANCEL keyed by trace_id — while v1
+  frames keep parsing and get v1 replies (old-client compat);
+- SLO burn math over synthetic profile history matches by hand;
+- the CLI tools exit 0/1/2 per their contracts.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+import types
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.bridge import protocol as P
+from spark_rapids_jni_tpu.engine import Aggregate, Scan
+from spark_rapids_jni_tpu.utils import blackbox, errors, faults, metrics
+from spark_rapids_jni_tpu.utils import config as cfg
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _recorder_isolation():
+    blackbox.reset()
+    yield
+    blackbox.reset()
+
+
+@pytest.fixture
+def env(monkeypatch):
+    """Set env vars + refresh; teardown scrubs them and refreshes again."""
+    touched = []
+
+    def _set(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+            touched.append(k)
+        cfg.refresh()
+    yield _set
+    for k in touched:
+        monkeypatch.delenv(k, raising=False)
+    cfg.refresh()
+    faults.reset()
+
+
+@pytest.fixture
+def warehouse(tmp_path):
+    n = 40_000
+    path = str(tmp_path / "fact.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array((np.arange(n) % 13).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    }), path, row_group_size=4096)
+    return path
+
+
+def _agg_plan(path, chunk_bytes=1 << 16):
+    return Aggregate(Scan(path, chunk_bytes=chunk_bytes),
+                     ["k"], [("v", "sum")], names=["s"])
+
+
+def _serve(tmp_path, name):
+    from spark_rapids_jni_tpu.bridge.server import BridgeServer
+    sock = str(tmp_path / name)
+    server = BridgeServer(sock)
+    st = threading.Thread(target=server.serve_forever, daemon=True)
+    st.start()
+    for _ in range(100):
+        if os.path.exists(sock):
+            break
+        time.sleep(0.01)
+    return sock, st
+
+
+# -- ids + scope --------------------------------------------------------------
+
+def test_trace_and_span_id_widths():
+    t, s = blackbox.new_trace_id(), blackbox.new_span_id()
+    assert len(t) == 32 and len(s) == 16
+    int(t, 16), int(s, 16)  # both parse as hex
+    assert blackbox.new_trace_id() != t
+
+
+def test_query_scope_is_reentrant_one_exec():
+    assert blackbox.current_trace() == ""
+    with blackbox.query_scope(label="outer") as outer:
+        assert outer.trace_id and blackbox.current_trace() == outer.trace_id
+        with blackbox.query_scope("f" * 32, label="inner") as inner:
+            # the nested scope joins the enclosing execution: same id,
+            # same exec_id — one post-mortem dedup key per top-level run
+            assert inner is outer
+            assert inner.trace_id == outer.trace_id != "f" * 32
+    assert blackbox.current_trace() == ""
+    evs = [e for e in blackbox.tail() if e.get("trace") == outer.trace_id]
+    # one begin/end pair — the inner scope did not bracket again
+    assert [e["ev"] for e in evs] == ["query.begin", "query.end"]
+
+
+def test_recorder_on_with_metrics_off(env):
+    env(SRJT_METRICS="0")
+    with blackbox.query_scope(label="m0") as s:
+        blackbox.record("exchange", kind="hash", rows=7)
+    evs = [e for e in blackbox.tail() if e.get("trace") == s.trace_id]
+    assert [e["ev"] for e in evs] == ["query.begin", "exchange",
+                                     "query.end"]
+    assert evs[1]["kind"] == "hash" and evs[1]["rows"] == 7
+
+
+def test_recorder_off_gate(env, tmp_path):
+    env(SRJT_BLACKBOX="0")
+    assert not blackbox.enabled()
+    blackbox.record("tick")
+    assert blackbox.tail() == []
+    assert blackbox.post_mortem("r", dir_path=str(tmp_path)) is None
+    assert blackbox.list_bundles(str(tmp_path)) == []
+
+
+def test_ring_overflow_keeps_newest(env):
+    env(SRJT_BLACKBOX_CAP="16")
+    for i in range(40):
+        blackbox.record("tick", i=i)
+    evs = [e for e in blackbox.tail() if e.get("ev") == "tick"]
+    assert [e["i"] for e in evs] == list(range(24, 40))
+    st = blackbox.ring_stats()
+    assert st["cap"] == 16 and st["events"] == 16 and st["drops"] >= 24
+
+
+# -- post-mortem bundles ------------------------------------------------------
+
+def test_post_mortem_bundle_schema_and_dedup(tmp_path):
+    d = str(tmp_path / "bb")
+    with blackbox.query_scope(label="pm") as s:
+        blackbox.record("retry", site="parquet.chunk", attempt=1,
+                        kind="transient")
+        p1 = blackbox.post_mortem("degrade:exchange-halved", dir_path=d)
+        e = errors.TransientError("boom")
+        p2 = blackbox.post_mortem("engine.execute:transient", exc=e,
+                                  dir_path=d)
+    # a degradation followed by the final error reuses the first bundle
+    assert p1 and p2 == p1
+    assert blackbox.list_bundles(d) == [p1]
+    assert e.trace_id == s.trace_id
+    assert e.bundle_path == p1
+    assert blackbox.last_bundle(s.trace_id) == p1
+    doc = blackbox.read_bundle(p1)
+    assert doc["version"] == blackbox.VERSION
+    assert doc["trace_id"] == s.trace_id
+    assert doc["reason"] == "degrade:exchange-halved"
+    assert any(ev["ev"] == "retry" and ev["kind"] == "transient"
+               for ev in doc["ring"])
+    assert "config" in doc and "faults" in doc and "progress" in doc
+
+
+def test_torn_bundle_write_leaves_nothing(tmp_path, monkeypatch):
+    d = str(tmp_path / "bb")
+    monkeypatch.setattr(blackbox, "json", types.SimpleNamespace(
+        dump=lambda *a, **k: (_ for _ in ()).throw(
+            ValueError("unserializable")),
+        load=json.load))
+    with blackbox.query_scope():
+        assert blackbox.post_mortem("r", dir_path=d) is None
+    assert blackbox.list_bundles(d) == []
+    # the .tmp half-file was removed, not left looking like a bundle
+    assert not any(n.endswith(".tmp") for n in os.listdir(d))
+
+
+def test_bundle_dir_is_bounded(tmp_path, monkeypatch):
+    monkeypatch.setattr(blackbox, "_DIR_KEEP", 5)
+    d = str(tmp_path / "bb")
+    paths = [blackbox.post_mortem(f"r{i}", trace_id=blackbox.new_trace_id(),
+                                  dir_path=d) for i in range(9)]
+    assert all(paths)
+    left = blackbox.list_bundles(d)
+    assert len(left) == 5
+    assert left == sorted(paths)[-5:]  # oldest pruned, newest kept
+
+
+# -- wire protocol v2 ---------------------------------------------------------
+
+def test_protocol_v2_roundtrip_and_v1_compat():
+    a, b = socket.socketpair()
+    try:
+        tid, sid = "ab" * 16, "cd" * 8
+        P.send_msg(a, P.OP_PING, b"hi", trace=(tid, sid))
+        assert P.recv_frame(b) == (P.OP_PING, b"hi", tid, sid)
+        # v1 frame: flag clear, no trace header
+        P.send_msg(a, P.OP_PING, b"yo")
+        assert P.recv_frame(b) == (P.OP_PING, b"yo", "", "")
+        # recv_msg drops the trace for legacy callers
+        P.send_msg(a, P.STATUS_OK, b"r", trace=(tid, sid))
+        assert P.recv_msg(b) == (P.STATUS_OK, b"r")
+        # malformed hex never poisons the frame: zero-filled ids
+        P.send_msg(a, P.OP_PING, trace=("not-hex", "zz"))
+        op, _, t0, s0 = P.recv_frame(b)
+        assert (op, t0, s0) == (P.OP_PING, "00" * 16, "00" * 8)
+        # a traced frame too short for its header is a broken peer
+        a.sendall(P._HDR.pack(6, P.OP_PING | P.TRACE_FLAG) + b"12345")
+        with pytest.raises(ConnectionError, match="too short"):
+            P.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_answers_v1_with_v1_and_mirrors_v2(tmp_path):
+    sock_path, st = _serve(tmp_path, "compat.sock")
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.connect(sock_path)
+    try:
+        # old client: v1 ping gets a v1 reply (no trace header)
+        P.send_msg(raw, P.OP_PING)
+        assert P.recv_frame(raw) == (P.STATUS_OK, b"pong", "", "")
+        # v2 ping: the reply mirrors the request's trace
+        tid, sid = blackbox.new_trace_id(), blackbox.new_span_id()
+        P.send_msg(raw, P.OP_PING, trace=(tid, sid))
+        status, _, rtid, rsid = P.recv_frame(raw)
+        assert (status, rtid, rsid) == (P.STATUS_OK, tid, sid)
+    finally:
+        raw.close()
+        from spark_rapids_jni_tpu.bridge import BridgeClient
+        c = BridgeClient(sock_path)
+        c.shutdown_server()
+        st.join(timeout=10)
+
+
+def test_bridge_trace_joins_server_summary(tmp_path, warehouse, env):
+    env(SRJT_METRICS="1")
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    sock, st = _serve(tmp_path, "join.sock")
+    c = BridgeClient(sock)
+    try:
+        assert len(c.trace_id) == 32
+        for h in c.execute_plan(_agg_plan(warehouse)):
+            c.release(h)
+        assert c.last_span_id  # every call minted a span
+        snap = c.metrics()
+        hits = [q for q in snap.get("queries") or []
+                if q.get("trace_id") == c.trace_id]
+        assert hits, snap.get("queries")
+        # the server snapshot carries the recorder's health block
+        assert snap.get("blackbox", {}).get("cap", 0) >= 16
+    finally:
+        c.shutdown_server()
+        st.join(timeout=10)
+
+
+def test_query_status_and_cancel_keyed_by_trace(tmp_path, warehouse, env):
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    # slow every chunk decode so the plan is reliably in flight
+    env(SRJT_FAULTS="parquet.chunk:*:timeout", SRJT_RETRY_BACKOFF_S="0.001")
+    faults.reset()
+    sock, st = _serve(tmp_path, "status.sock")
+    c1 = BridgeClient(sock)
+    result: list = []
+
+    def submit():
+        try:
+            result.append(("ok", c1.execute_plan(_agg_plan(warehouse))))
+        except Exception as e:  # noqa: BLE001 — the test classifies
+            result.append(("err", e))
+
+    worker = threading.Thread(target=submit, daemon=True)
+    worker.start()
+    time.sleep(0.3)  # plan is mid-stream now
+    c2 = BridgeClient(sock)
+    try:
+        live = c2.query_status()  # empty payload = legacy all-queries
+        assert live and any(q.get("trace_id") == c1.trace_id for q in live)
+        mine = c2.query_status(trace_id=c1.trace_id)
+        assert mine and all(q["trace_id"] == c1.trace_id for q in mine)
+        assert c2.query_status(trace_id="0" * 32) == []
+        # cancel keyed by a foreign trace touches nothing...
+        assert c2.cancel("0" * 32) == 0
+        # ...and by the submitter's trace kills exactly that query
+        assert c2.cancel(c1.trace_id) == 1
+        worker.join(timeout=30)
+        assert result and result[0][0] == "err"
+        err = result[0][1]
+        assert errors.classify(err)[0] == "cancelled", err
+        # the typed client exception carries the trace it failed under
+        assert getattr(err, "trace_id", "") == c1.trace_id
+    finally:
+        c2.shutdown_server()
+        c1.close()
+        st.join(timeout=10)
+
+
+def test_failing_plan_execute_joins_bundle(tmp_path, warehouse, env):
+    """The serving-path acceptance path in-process: typed exception,
+    post-mortem bundle, and profile entry all share the client's trace."""
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    bb = str(tmp_path / "bb")
+    prof_dir = str(tmp_path / "profiles")
+    env(SRJT_FAULTS="parquet.chunk:*:io_error",
+        SRJT_RETRY_BACKOFF_S="0.001", SRJT_BLACKBOX_DIR=bb,
+        SRJT_PROFILE_DIR=prof_dir, SRJT_METRICS="1")
+    faults.reset()
+    sock, st = _serve(tmp_path, "fail.sock")
+    c = BridgeClient(sock)
+    try:
+        with pytest.raises(errors.TransientError) as ei:
+            c.execute_plan(_agg_plan(warehouse))
+        err = ei.value
+        assert err.trace_id == c.trace_id
+        bundles = blackbox.list_bundles(bb)
+        assert len(bundles) == 1
+        doc = blackbox.read_bundle(bundles[0])
+        assert doc["trace_id"] == c.trace_id
+        # the bundle keeps the raw server-side exception; the client
+        # reconstructs the typed TransientError from the wire taxonomy
+        assert doc["error"]["type"] == "InjectedIOError"
+        assert doc["error"]["kind"] == "transient"
+        assert "traceback" in doc["error"]
+        # the wire error doc named this exact bundle
+        assert os.path.basename(err.bundle_path) == \
+            os.path.basename(bundles[0])
+        from spark_rapids_jni_tpu.utils import profile
+        profs = [profile.read(p) for p in profile.list_profiles(prof_dir)]
+        hit = [p for p in profs if p.get("trace_id") == c.trace_id]
+        assert hit and hit[0]["outcome"]["status"] == "error"
+    finally:
+        c.shutdown_server()
+        st.join(timeout=10)
+
+
+# -- SLO layer ----------------------------------------------------------------
+
+def test_slo_targets_grammar(env):
+    env(SRJT_SLO_MS=" 500 , ab12cd=200 , bogus=x , 250 ")
+    default_ms, per = blackbox.slo_targets()
+    assert default_ms == 250.0  # last bare number wins
+    assert per == {"ab12cd": 200.0}
+    assert blackbox.slo_enabled()
+
+
+def _put_profile(d, seq, fp, wall_s, err=False):
+    doc = {"fingerprint": fp, "source_fingerprint": fp, "wall_s": wall_s}
+    if err:
+        doc["outcome"] = {"status": "error"}
+    with open(os.path.join(d, f"profile-{seq:020d}-{fp[:12]}.json"),
+              "w") as f:
+        json.dump(doc, f)
+
+
+def test_slo_burn_math(tmp_path, env):
+    d = str(tmp_path / "prof")
+    os.makedirs(d)
+    fp_a, fp_e = "aaaabbbbccccdddd", "eeeeffff00001111"
+    _put_profile(d, 1, fp_a, 0.1)            # 100ms <= 500: ok
+    _put_profile(d, 2, fp_a, 0.9)            # 900ms > 500: breach
+    _put_profile(d, 3, fp_a, 0.2, err=True)  # error: breach regardless
+    _put_profile(d, 4, fp_e, 0.3)            # 300ms > 200 override: breach
+    env(SRJT_SLO_MS="500,eeeeffff=200")
+    rep = blackbox.slo_report(d)
+    assert rep["enabled"] and rep["default_ms"] == 500.0
+    by = {e["fingerprint"]: e for e in rep["entries"]}
+    a = by[fp_a[:12]]
+    assert (a["runs"], a["breaches"], a["errors"]) == (3, 2, 1)
+    assert a["burn_rate"] == round(2 / 3, 4)
+    assert a["worst_ms"] == 900.0 and a["objective_ms"] == 500.0
+    e = by[fp_e[:12]]
+    assert (e["objective_ms"], e["runs"], e["breaches"]) == (200.0, 1, 1)
+    # sorted hottest-first: the 100%-burn fingerprint leads
+    assert rep["entries"][0]["fingerprint"] == fp_e[:12]
+    # override-only spec: unlisted fingerprints opt out entirely
+    env(SRJT_SLO_MS="eeeeffff=200")
+    rep = blackbox.slo_report(d)
+    assert [x["fingerprint"] for x in rep["entries"]] == [fp_e[:12]]
+
+
+def test_prometheus_slo_gauges(tmp_path, env, metrics_isolation):
+    metrics_isolation("test.slo")
+    d = str(tmp_path / "prof")
+    os.makedirs(d)
+    _put_profile(d, 1, "aaaabbbbccccdddd", 0.9)
+    env(SRJT_SLO_MS="500", SRJT_PROFILE_DIR=d)
+    metrics.count("test.slo.tick")
+    text = metrics.prometheus_text()
+    assert "srjt_slo_default_objective_ms 500" in text
+    assert 'srjt_slo_burn_rate{fingerprint="aaaabbbbcccc"} 1' in text
+    assert 'srjt_slo_objective_ms{fingerprint="aaaabbbbcccc"} 500' in text
+
+
+# -- CLI exit codes -----------------------------------------------------------
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_blackbox_cli_exit_codes(tmp_path, capsys):
+    bbx = _load_tool("srjt_blackbox")
+    # no dir configured anywhere: usage error
+    assert not cfg.config.blackbox_dir
+    with pytest.raises(SystemExit) as se:
+        bbx.main(["list"])
+    assert se.value.code == 2
+    d = str(tmp_path / "bb")
+    with blackbox.query_scope() as s:
+        blackbox.record("retry", site="unit")
+        path = blackbox.post_mortem(
+            "unit", exc=errors.TransientError("boom"), dir_path=d)
+    assert path
+    assert bbx.main(["--dir", d, "list"]) == 0
+    assert bbx.main(["--dir", d, "show", "-1", "--ring"]) == 0
+    out = capsys.readouterr().out
+    assert s.trace_id[:12] in out and '"ev": "retry"' in out
+    # grep: prefix hit = 0, miss = 1
+    assert bbx.main(["--dir", d, "grep", s.trace_id[:8]]) == 0
+    assert bbx.main(["--dir", d, "grep", "f" * 32]) == 1
+    # bad index: usage error
+    with pytest.raises(SystemExit) as se:
+        bbx.main(["--dir", d, "show", "-99"])
+    assert se.value.code == 2
+
+
+def test_profile_cli_slo_exit_codes(tmp_path, capsys):
+    prof = _load_tool("srjt_profile")
+    d = str(tmp_path / "prof")
+    os.makedirs(d)
+    _put_profile(d, 1, "aaaabbbbccccdddd", 0.9)
+    try:
+        # no objectives declared: usage error
+        assert prof.main(["--dir", d, "slo"]) == 2
+        assert prof.main(["--dir", d, "slo", "--slo-ms", "500"]) == 0
+        out = capsys.readouterr().out
+        assert "aaaabbbbcccc" in out and "burn_rate=1.0" in out
+    finally:
+        cfg.refresh()  # cmd_slo writes config.slo_ms session-locally

@@ -25,7 +25,8 @@ import pytest
 from spark_rapids_jni_tpu.engine import Aggregate, Scan, execute
 from spark_rapids_jni_tpu.engine.plan import Exchange
 from spark_rapids_jni_tpu.utils import config as cfg
-from spark_rapids_jni_tpu.utils import errors, faults, metrics, tracing
+from spark_rapids_jni_tpu.utils import (blackbox, errors, faults, metrics,
+                                        tracing)
 
 
 @pytest.fixture
@@ -301,6 +302,29 @@ def test_staging_oom_degrades_to_interpreted(
     assert stats["row_groups_read"] == base_stats["row_groups_read"]
     assert stats["row_groups_pruned"] == base_stats["row_groups_pruned"]
     assert not stats.get("fused_segments")  # the re-run never fused
+
+
+def test_degradation_stamps_flight_recorder(warehouse, arm):
+    """Every degradation rung leaves flight-recorder evidence: a
+    ``degrade`` event and a dedup-keyed post-mortem attempt, all under
+    the one trace the run's begin/end bracket carries."""
+    blackbox.reset()
+    arm("staging.transfer:1:oom")
+    execute(_agg_plan(warehouse))
+    ring = blackbox.tail()
+    degr = [e for e in ring if e.get("ev") == "degrade"]
+    assert [d["step"] for d in degr] == ["stream-interpreted"]
+    assert degr[0]["kind"] == "resource" and degr[0].get("trace")
+    tid = degr[0]["trace"]
+    # no SRJT_BLACKBOX_DIR armed: the post-mortem attempt is itself an
+    # event, marked unwritten, on the same trace
+    pms = [e for e in ring if e.get("ev") == "post_mortem"
+           and e.get("trace") == tid]
+    assert pms and pms[0]["reason"] == "degrade:stream-interpreted"
+    assert pms[0]["written"] is False
+    brackets = [e["ev"] for e in ring if e.get("trace") == tid
+                and e["ev"].startswith("query.")]
+    assert brackets == ["query.begin", "query.end"]
 
 
 def test_error_outcome_recorded(warehouse, arm, metrics_isolation):

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Flight-recorder bundle CLI: list / show / grep post-mortem bundles.
+
+The reading half of ``utils/blackbox.py`` (docs/OBSERVABILITY.md): on a
+classified error, timeout, cancel, or degradation the engine writes one
+post-mortem bundle into ``SRJT_BLACKBOX_DIR``; this tool renders the
+bundle ring without touching devices — pure JSON over the on-disk files,
+safe to run anywhere the directory is mounted.
+
+Usage::
+
+    python tools/srjt_blackbox.py list  [--dir DIR]
+    python tools/srjt_blackbox.py show  [--dir DIR] [PATH|-1] [--ring]
+    python tools/srjt_blackbox.py grep  [--dir DIR] TRACE_ID
+
+``show`` defaults to the newest bundle; ``--ring`` appends the captured
+flight-recorder tail as one event per line.  ``grep`` matches bundles
+whose trace_id starts with the given hex prefix (the id a failed client
+call carries as ``e.trace_id``).  Exit code 0 on success (grep: at least
+one match), 1 on no match, 2 on usage errors (no directory, empty ring,
+bad index).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from spark_rapids_jni_tpu.utils import blackbox  # noqa: E402
+from spark_rapids_jni_tpu.utils.config import config  # noqa: E402
+
+
+def _dir_of(args) -> str:
+    d = args.dir or config.blackbox_dir
+    if not d:
+        print("bundle dir not set (use --dir or SRJT_BLACKBOX_DIR)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return d
+
+
+def _describe(path: str) -> str:
+    try:
+        doc = blackbox.read_bundle(path)
+    except (OSError, ValueError) as e:
+        return f"{os.path.basename(path)}  <unreadable: {e}>"
+    err = doc.get("error") or {}
+    q = doc.get("query") or {}
+    bits = [os.path.basename(path),
+            f"trace={doc.get('trace_id', '')[:12] or '?'}",
+            f"reason={doc.get('reason', '?')}"]
+    if err:
+        bits.append(f"error={err.get('type', '?')}/{err.get('kind', '?')}")
+    if q:
+        bits.append(f"query={q.get('name', '')!r} wall={q.get('wall_s')}s")
+    bits.append(f"ring={len(doc.get('ring') or ())}ev")
+    return "  ".join(bits)
+
+
+def cmd_list(args) -> int:
+    d = _dir_of(args)
+    paths = blackbox.list_bundles(d)
+    for p in paths:
+        print(_describe(p))
+    print(f"-- {len(paths)} bundle(s) in {d}")
+    return 0
+
+
+def _resolve(d: str, spec: str | None) -> str:
+    """A path, or a negative index into the chronological ring (-1 =
+    newest); default newest."""
+    if spec and not spec.lstrip("-").isdigit():
+        return spec if os.path.sep in spec else os.path.join(d, spec)
+    paths = blackbox.list_bundles(d)
+    if not paths:
+        print(f"no bundles in {d}", file=sys.stderr)
+        raise SystemExit(2)
+    idx = int(spec) if spec else -1
+    try:
+        return paths[idx]
+    except IndexError:
+        print(f"index {idx} out of range ({len(paths)} bundles)",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+def cmd_show(args) -> int:
+    path = _resolve(_dir_of(args), args.path)
+    doc = blackbox.read_bundle(path)
+    ring = doc.pop("ring", [])
+    print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    if args.ring:
+        print(f"-- flight-recorder tail ({len(ring)} events):")
+        for ev in ring:
+            print("  " + json.dumps(ev, sort_keys=True, default=str))
+    return 0
+
+
+def cmd_grep(args) -> int:
+    """Bundles whose trace_id starts with the given hex prefix — the
+    client-to-server join: paste ``e.trace_id`` from a failed call."""
+    d = _dir_of(args)
+    want = args.trace_id.strip().lower()
+    if not want:
+        print("empty trace id", file=sys.stderr)
+        return 2
+    hits = 0
+    for p in blackbox.list_bundles(d):
+        try:
+            doc = blackbox.read_bundle(p)
+        except (OSError, ValueError):
+            continue
+        if str(doc.get("trace_id", "")).lower().startswith(want):
+            hits += 1
+            print(_describe(p))
+    if not hits:
+        print(f"no bundle matches trace {want!r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srjt_blackbox", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default=None,
+                    help="bundle directory (default SRJT_BLACKBOX_DIR)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="one line per stored bundle")
+    p_show = sub.add_parser("show", help="pretty-print one bundle")
+    p_show.add_argument("path", nargs="?", default=None,
+                        help="path, filename, or negative index "
+                             "(-1 = newest)")
+    p_show.add_argument("--ring", action="store_true",
+                        help="append the flight-recorder tail, one event "
+                             "per line")
+    p_grep = sub.add_parser("grep",
+                            help="bundles matching a trace-id prefix")
+    p_grep.add_argument("trace_id", help="hex trace id (prefix ok)")
+    args = ap.parse_args(argv)
+    return {"list": cmd_list, "show": cmd_show,
+            "grep": cmd_grep}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    try:
+        code = main()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe mid-print: normal exit,
+        # but devnull stdout first so interpreter teardown can't re-raise
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    raise SystemExit(code)

@@ -2,7 +2,10 @@
 """Prometheus text-exposition exporter for the engine metrics registries.
 
 One scrape = one dump of the counter/gauge/histogram registries (plus the
-live-query progress gauges) in Prometheus text exposition format v0.0.4 —
+live-query progress gauges and, when ``SRJT_SLO_MS`` declares objectives,
+the per-fingerprint ``srjt_slo_*`` burn-rate gauges — evaluated by the
+server for ``--socket`` scrapes, locally otherwise) in Prometheus text
+exposition format v0.0.4 —
 pipe it into a node_exporter textfile collector, a pushgateway, or curl's
 stdin.  Two sources:
 

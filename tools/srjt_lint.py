@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Repo lint for the engine's static invariants (docs/ANALYSIS.md pass 3).
 
-Five stdlib-``ast`` rules over ``spark_rapids_jni_tpu/`` + ``tools/``:
+Six stdlib-``ast`` rules over ``spark_rapids_jni_tpu/`` + ``tools/``:
 
 - **traced-host-op** — no ``.item()`` / ``float()`` / ``bool()`` / ``int()``
   / ``np.asarray`` / ``.tolist()`` / ``jax.device_get`` /
@@ -33,6 +33,13 @@ Five stdlib-``ast`` rules over ``spark_rapids_jni_tpu/`` + ``tools/``:
   (engine/recovery.py) dispatches on the ``utils/errors`` taxonomy, and a
   bare catch swallows cancellation and resource exhaustion
   indistinguishably.
+- **unregistered-metric** — every literal metric name recorded through
+  ``metrics.count/observe/gauge_set/gauge_max/time_add`` /
+  ``tracing.count`` (and every literal ``node_set`` span label) must
+  appear in the generated catalog ``docs/METRICS.md``; f-string names
+  catalog with ``<var>`` placeholders.  A name in the catalog with no
+  remaining call site flags ``stale-metric``.  Regenerate with
+  ``--write-metrics`` — the catalog diff IS the metric-rename review.
 
 Plus two import-time passes:
 
@@ -52,6 +59,7 @@ Usage::
     python tools/srjt_lint.py --baseline ci/lint-baseline.json
     python tools/srjt_lint.py --segments --baseline ci/lint-baseline.json
     python tools/srjt_lint.py --write-baseline   # regenerate the baseline
+    python tools/srjt_lint.py --write-metrics    # regenerate docs/METRICS.md
 
 Violations not covered by the baseline exit nonzero.
 """
@@ -96,6 +104,43 @@ _MUTATING_METHODS = {"append", "appendleft", "add", "update", "setdefault",
 _LOCKISH = ("lock", "cond", "mutex", "_cv")
 #: docstring marker asserting the caller already holds the guarding lock
 _LOCK_HELD_DOC = "(lock held)"
+
+#: registry entry points whose first argument is a metric name, and the
+#: catalog kind each registers under (docs/METRICS.md)
+_METRIC_FNS = {"count": "counter", "observe": "histogram",
+               "gauge_set": "gauge", "gauge_max": "gauge",
+               "time_add": "timer"}
+#: receiver names that denote the metrics/tracing registries at call sites
+#: (bridge/server.py imports the module as `_metrics`)
+_METRIC_BASES = {"metrics", "_metrics", "tracing"}
+#: repo-relative path of the generated metric-name catalog
+METRICS_DOC = os.path.join("docs", "METRICS.md")
+
+
+def _literal_metric_name(arg) -> "str | None":
+    """A metric-name argument as a catalogable string: literal strings
+    verbatim, f-strings with each interpolation normalized to a ``<var>``
+    placeholder (so ``f"engine.errors.{kind}"`` catalogs once as
+    ``engine.errors.<kind>``), fully dynamic expressions -> None
+    (plumbing forwarders like ``tracing.count(name, n)`` are not call
+    sites)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                inner = v.value
+                if isinstance(inner, ast.Name):
+                    parts.append(f"<{inner.id}>")
+                elif isinstance(inner, ast.Attribute):
+                    parts.append(f"<{inner.attr}>")
+                else:
+                    parts.append("<?>")
+        return "".join(parts)
+    return None
 
 
 def _module_mutable_globals(tree: ast.Module) -> set:
@@ -158,6 +203,7 @@ class _FileLint(ast.NodeVisitor):
         self.whitelist = whitelist
         self.mutable_globals = mutable_globals
         self.out: list = []
+        self.metric_sites: list = []  # (name, kind, relpath, line)
         self._traced_depth = 0
         self._func_depth = 0
         self._lock_depth = 0
@@ -282,10 +328,29 @@ class _FileLint(ast.NodeVisitor):
                 f"SYNC_WHITELIST" if labels else
                 "metrics.host_sync without a whitelisted literal label="))
 
+    # -- unregistered-metric -----------------------------------------------
+
+    def _collect_metric(self, node: ast.Call) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        if fn.attr in _METRIC_FNS and isinstance(fn.value, ast.Name) \
+                and fn.value.id in _METRIC_BASES and node.args:
+            name = _literal_metric_name(node.args[0])
+            if name is not None:
+                self.metric_sites.append(
+                    (name, _METRIC_FNS[fn.attr], self.relpath, node.lineno))
+        elif fn.attr == "node_set" and len(node.args) >= 2:
+            label = _literal_metric_name(node.args[1])
+            if label is not None:
+                self.metric_sites.append(
+                    (label, "span", self.relpath, node.lineno))
+
     def visit_Call(self, node: ast.Call) -> None:
         if self._traced_depth:
             self._check_traced_call(node)
         self._check_host_sync(node)
+        self._collect_metric(node)
         fn = node.func
         if isinstance(fn, ast.Attribute):
             if isinstance(fn.value, ast.Name) and \
@@ -318,8 +383,78 @@ class _FileLint(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def ast_pass(whitelist: tuple, roots: tuple = (PKG, "tools")) -> list:
+def _metric_catalog(sites: list) -> dict:
+    """Aggregate (name, kind, file, line) sites into
+    name -> {"kinds": set, "files": set}."""
+    cat: dict = {}
+    for name, kind, relpath, _line in sites:
+        e = cat.setdefault(name, {"kinds": set(), "files": set()})
+        e["kinds"].add(kind)
+        e["files"].add(relpath)
+    return cat
+
+
+def _registered_metrics(doc_path: str) -> set:
+    """Names from the catalog's table rows (first backticked token of
+    each ``| `name` | ...`` line); prose backticks don't register."""
+    names: set = set()
+    if not os.path.exists(doc_path):
+        return names
+    with open(doc_path) as f:
+        for line in f:
+            if line.startswith("| `") and line.count("`") >= 2:
+                names.add(line.split("`", 2)[1])
+    return names
+
+
+def render_metrics_doc(catalog: dict) -> str:
+    lines = [
+        "# Metric-name catalog",
+        "",
+        "Generated by `python tools/srjt_lint.py --write-metrics` from the",
+        "literal names at `metrics.count` / `observe` / `gauge_set` /",
+        "`gauge_max` / `time_add` / `tracing.count` / `node_set` call",
+        "sites; `<var>` marks an f-string interpolation (one row per",
+        "template, however many concrete names it expands to).  Do not",
+        "edit by hand: a call site recording a name missing here fails",
+        "the lint (`unregistered-metric`), and a row with no remaining",
+        "call site fails it too (`stale-metric`) — every metric rename is",
+        "one reviewable catalog diff.",
+        "",
+        "| name | kind | call sites |",
+        "|---|---|---|",
+    ]
+    for name in sorted(catalog):
+        e = catalog[name]
+        lines.append(f"| `{name}` | {', '.join(sorted(e['kinds']))} | "
+                     f"{', '.join(sorted(e['files']))} |")
+    lines += ["", f"{len(catalog)} names."]
+    return "\n".join(lines) + "\n"
+
+
+def metrics_doc_pass(catalog: dict, doc_path: str) -> list:
+    """Two-way diff of the call-site catalog against docs/METRICS.md."""
+    registered = _registered_metrics(doc_path)
+    rel = os.path.relpath(doc_path, REPO)
+    out: list = []
+    for name in sorted(set(catalog) - registered):
+        site = sorted(catalog[name]["files"])[0]
+        out.append(_violation(
+            "unregistered-metric", site, 0,
+            f"metric name `{name}` not in {rel} "
+            f"(regenerate: tools/srjt_lint.py --write-metrics)"))
+    for name in sorted(registered - set(catalog)):
+        out.append(_violation(
+            "stale-metric", rel, 0,
+            f"catalog entry `{name}` has no remaining call site "
+            f"(regenerate: tools/srjt_lint.py --write-metrics)"))
+    return out
+
+
+def ast_pass(whitelist: tuple, roots: tuple = (PKG, "tools"),
+             sites_out: "list | None" = None) -> list:
     violations: list = []
+    sites: list = []
     for root in roots:
         for dirpath, dirnames, filenames in os.walk(
                 os.path.join(REPO, root)):
@@ -335,6 +470,11 @@ def ast_pass(whitelist: tuple, roots: tuple = (PKG, "tools")) -> list:
                                  _module_mutable_globals(tree))
                 lint.visit(tree)
                 violations += lint.out
+                sites += lint.metric_sites
+    if sites_out is not None:
+        sites_out.extend(sites)
+    violations += metrics_doc_pass(_metric_catalog(sites),
+                                   os.path.join(REPO, METRICS_DOC))
     return violations
 
 
@@ -452,6 +592,9 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite --baseline (default ci/lint-baseline.json)"
                          " from the current violations")
+    ap.add_argument("--write-metrics", action="store_true",
+                    help="regenerate docs/METRICS.md from the metric-name "
+                         "call sites")
     ap.add_argument("--segments", action="store_true",
                     help="also jaxpr-lint the smoke plans' fused segments")
     ap.add_argument("--full", action="store_true",
@@ -464,7 +607,17 @@ def main(argv=None) -> int:
     sys.path.insert(0, REPO)
     from spark_rapids_jni_tpu.engine.verify import SYNC_WHITELIST
 
-    violations = ast_pass(tuple(SYNC_WHITELIST))
+    sites: list = []
+    violations = ast_pass(tuple(SYNC_WHITELIST), sites_out=sites)
+    if args.write_metrics:
+        doc_path = os.path.join(REPO, METRICS_DOC)
+        catalog = _metric_catalog(sites)
+        os.makedirs(os.path.dirname(doc_path), exist_ok=True)
+        with open(doc_path, "w") as f:
+            f.write(render_metrics_doc(catalog))
+        print(f"srjt-lint: wrote {len(catalog)} metric name(s) to "
+              f"{os.path.relpath(doc_path, REPO)}")
+        return 0
     violations += dispatch_pass()
     if args.segments or args.full:
         violations += segments_pass(full=args.full)

@@ -12,11 +12,15 @@ Usage::
     python tools/srjt_profile.py show      [--dir DIR] [PATH|-1]
     python tools/srjt_profile.py diff      [--dir DIR] [BASE CAND]
     python tools/srjt_profile.py decisions [--dir DIR] [PATH|-1]
+    python tools/srjt_profile.py slo       [--dir DIR] [--slo-ms SPEC]
 
 ``diff`` with no positional arguments picks the two newest profiles
 sharing a plan fingerprint (the cross-run EXPLAIN ANALYZE comparison);
-with explicit paths it diffs exactly those.  Exit code 0 on success, 2 on
-usage errors (empty store, no fingerprint pair).
+with explicit paths it diffs exactly those.  ``slo`` renders per-source-
+fingerprint burn rates against the ``SRJT_SLO_MS`` objectives (override
+with ``--slo-ms``), evaluated from the stored history by
+``utils/blackbox.py``.  Exit code 0 on success, 2 on usage errors (empty
+store, no fingerprint pair, no objectives declared).
 """
 
 from __future__ import annotations
@@ -182,6 +186,30 @@ def cmd_decisions(args) -> int:
     return 0
 
 
+def cmd_slo(args) -> int:
+    """Per-source-fingerprint SLO burn table from profile-store history."""
+    d = _dir_of(args)
+    from spark_rapids_jni_tpu.utils import blackbox
+    from spark_rapids_jni_tpu.utils.config import config
+    if args.slo_ms is not None:
+        config.slo_ms = args.slo_ms  # session-local; config.refresh resets
+    rep = blackbox.slo_report(d)
+    if not rep["enabled"]:
+        print("no SLO objectives declared (set SRJT_SLO_MS or --slo-ms, "
+              "e.g. '500' or '500,ab12cd34ef56=200')", file=sys.stderr)
+        return 2
+    print(f"SLO objectives: default={rep['default_ms']}ms "
+          f"({len(rep['entries'])} fingerprint(s) with history)")
+    for e in rep["entries"]:
+        print(f"  {e['fingerprint']}  objective={e['objective_ms']}ms "
+              f"runs={e['runs']} breaches={e['breaches']} "
+              f"(errors={e['errors']}) worst={e['worst_ms']}ms "
+              f"burn_rate={e['burn_rate']}")
+    if not rep["entries"]:
+        print("  (no stored runs match the objectives)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="srjt_profile", description=__doc__,
@@ -204,9 +232,14 @@ def main(argv=None) -> int:
                           "scored against the run's actuals")
     p_dec.add_argument("path", nargs="?", default=None,
                        help="path, filename, or negative index (-1 = newest)")
+    p_slo = sub.add_parser(
+        "slo", help="per-fingerprint SLO burn rates from stored history")
+    p_slo.add_argument("--slo-ms", default=None,
+                       help="objectives spec overriding SRJT_SLO_MS "
+                            "(default_ms[,fp_prefix=ms,...])")
     args = ap.parse_args(argv)
     return {"list": cmd_list, "show": cmd_show, "diff": cmd_diff,
-            "decisions": cmd_decisions}[args.cmd](args)
+            "decisions": cmd_decisions, "slo": cmd_slo}[args.cmd](args)
 
 
 if __name__ == "__main__":
