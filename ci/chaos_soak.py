@@ -23,6 +23,13 @@ matches the one the raised exception carries (``e.trace_id``), a parity
 run cuts at most one (the degradation ladder bundles too), the clean
 oracle runs cut none, and the bundle directory stays bounded.
 
+A concurrent-clients scenario repeats the contract under multi-tenant
+contention: four bridge clients run distinct plans at once against a
+subprocess server with faults armed in ITS env — an absorbed fault must
+leave every client's result bit-exact (zero cross-session leakage), and
+an unabsorbable fault must hand every client a typed error joined 1:1
+to a fresh server-side bundle by trace id.
+
 Run directly::
 
     JAX_PLATFORMS=cpu python ci/chaos_soak.py
@@ -73,6 +80,23 @@ def _parity(base, out, key) -> bool:
     if base.num_rows != out.num_rows or base.num_columns != out.num_columns:
         return False
     for x, y in zip(_sorted_columns(base, key), _sorted_columns(out, key)):
+        if not np.allclose(np.asarray(x, np.float64),
+                           np.asarray(y, np.float64)):
+            return False
+    return True
+
+
+def _parity_by_index(base, out, idx=0) -> bool:
+    """Like ``_parity`` but key-sorts by column INDEX: tables exported
+    over the bridge carry data only, no column names."""
+    import numpy as np
+    if base.num_rows != out.num_rows or base.num_columns != out.num_columns:
+        return False
+
+    def cols(t):
+        order = np.argsort(np.asarray(t.columns[idx].data), kind="stable")
+        return [np.asarray(c.data)[order] for c in t.columns]
+    for x, y in zip(cols(base), cols(out)):
         if not np.allclose(np.asarray(x, np.float64),
                            np.asarray(y, np.float64)):
             return False
@@ -204,6 +228,109 @@ def main(argv=None) -> int:
         failures.append(f"spill: {len(left)} file(s) left in {sd}: {left}")
     os.environ.pop("SRJT_FAULTS", None)
     refresh()
+
+    # concurrent-clients scenario: the fault matrix under multi-tenant
+    # contention (engine/scheduler.py).  Four bridge clients run four
+    # distinct-fingerprint plans at once against a real subprocess server
+    # with faults armed in the SERVER env.  Two sub-scenarios:
+    #  - an nth-shot fault the recovery layer absorbs: every client must
+    #    still get ITS OWN plan's result bit-exact (zero cross-session
+    #    leakage — a retried chunk must never land in a neighbor's
+    #    accumulator);
+    #  - an every-time fault no ladder can absorb: every client must get
+    #    a typed, classified error carrying its own trace id, and the
+    #    server must cut EXACTLY one trace-joined bundle per typed error.
+    from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
+    n_clients = 4
+    conc_plans = bench._serving_plans(root, 64_000, n_clients)
+    conc_oracle = [execute(optimize(p)) for p in conc_plans]
+
+    def _concurrent_pass(tag, fault_spec, bb):
+        sock = os.path.join(tempfile.mkdtemp(prefix="srjt-chaos-srv-"),
+                            "srv.sock")
+        proc = spawn_server(sock, env={
+            "SRJT_FAULTS": fault_spec,
+            "SRJT_BLACKBOX_DIR": bb,
+            "SRJT_RETRY_BACKOFF_S": "0.001",
+            "SRJT_QUERY_TIMEOUT_S": "120",
+        })
+        results: dict = {}
+        errs: dict = {}
+        barrier = threading.Barrier(n_clients)
+
+        def one(i):
+            try:
+                c = BridgeClient(sock)
+                barrier.wait()
+                hs = c.execute_plan(conc_plans[i])
+                results[i] = c.export_table(hs[0])
+                for h in hs:
+                    c.release(h)
+                c.close()
+            except Exception as e:  # noqa: BLE001 — classified below
+                errs[i] = e
+        ts = [threading.Thread(target=one, args=(i,))
+              for i in range(n_clients)]
+        try:
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+            ctl = BridgeClient(sock)
+            ctl.shutdown_server()
+        except Exception as e:  # noqa: BLE001 — the soak classifies
+            failures.append(f"{tag}: harness error {e!r}")
+            proc.kill()
+        finally:
+            proc.wait(timeout=30)
+        return results, errs
+
+    bb_absorb = tempfile.mkdtemp(prefix="srjt-chaos-bb-conc1-")
+    res, errs = _concurrent_pass("concurrent/absorbed",
+                                 "parquet.chunk:2:io_error", bb_absorb)
+    runs += n_clients
+    for i in range(n_clients):
+        if i in errs:
+            failures.append(f"concurrent/absorbed: client {i} errored "
+                            f"({errs[i]!r}), want recovery parity")
+        elif not _parity_by_index(conc_oracle[i], res[i]):
+            failures.append(f"concurrent/absorbed: client {i} result "
+                            "diverged from its oracle (cross-session "
+                            "leakage or lost chunk)")
+        else:
+            outcomes_parity += 1
+    print(f"  concurrent/absorbed: {len(res)}/{n_clients} parity under "
+          f"nth-shot fault, {len(errs)} error(s)")
+
+    bb_hard = tempfile.mkdtemp(prefix="srjt-chaos-bb-conc2-")
+    res, errs = _concurrent_pass("concurrent/typed",
+                                 "parquet.chunk:*:io_error", bb_hard)
+    runs += n_clients
+    bundles = {blackbox.read_bundle(os.path.join(bb_hard, f))
+               .get("trace_id"): f for f in blackbox.list_bundles(bb_hard)}
+    for i in range(n_clients):
+        e = errs.get(i)
+        if e is None:
+            failures.append("concurrent/typed: client "
+                            f"{i} succeeded under an every-time fault")
+            continue
+        kind, _ = errors.classify(e)
+        if kind == errors.KIND_FATAL:
+            failures.append(f"concurrent/typed: client {i} got FATAL "
+                            f"{type(e).__name__}: {e}")
+            continue
+        outcomes_typed += 1
+        tid = getattr(e, "trace_id", "")
+        if not tid or tid not in bundles:
+            failures.append(f"concurrent/typed: client {i} trace "
+                            f"{tid!r} has no joined bundle "
+                            f"(bundles: {sorted(bundles)})")
+    if len(blackbox.list_bundles(bb_hard)) != len(errs):
+        failures.append(
+            f"concurrent/typed: {len(blackbox.list_bundles(bb_hard))} "
+            f"bundle(s) for {len(errs)} typed error(s), want 1:1")
+    print(f"  concurrent/typed: {len(errs)}/{n_clients} typed errors, "
+          f"{len(bundles)} trace-joined bundle(s)")
 
     # leak checks: every prefetch producer must have been reaped inside
     # its join window, and no soak run may leave a live worker behind

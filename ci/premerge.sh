@@ -160,6 +160,28 @@ print("aqe: %d skew split(s) applied, skew %.2f -> gauge %.2f "
       "rerun_vs_first %s" % (sk["splits_applied"], sk["pre_skew"],
                              sk["gauge_skew"], sk["threshold"],
                              aqe[0]["rerun_vs_first"]))
+# multi-tenant serving (docs/SERVING.md): the concurrent pass must be
+# bit-exact per trace vs the serial pass, the forced-low-SLO scenario
+# must shed at least once with the typed admission error carrying
+# trace id + bundle pointer, and the repeat plan must serve from the
+# result cache far under its cold wall.  The wall-clock keys
+# (serving.p99_ms / serving.throughput / serving.shed_count) stay
+# report-only in the gate below; this block asserts the structure.
+srv = [s for s in snaps if s.get("metric") == "serving"]
+assert srv, "bench.py --smoke emitted no serving line"
+assert srv[0]["ok"], "serving line not ok: %r" % srv[0]
+shed = srv[0]["shed"]
+assert shed and shed["kind"] == "resource" and shed["retryable"] is False, \
+    "shed not the typed admission error: %r" % shed
+assert shed["trace_id"] and shed["bundle"], \
+    "shed error missing trace/bundle join: %r" % shed
+assert srv[0]["shed_count"] >= 1, "no shed counted: %r" % srv[0]
+assert srv[0]["result_cache_speedup"] > 10, \
+    "result-cache repeat not well under cold wall: %r" % srv[0]
+print("serving: %d clients bit-exact, p99 %.0fms, %d shed (typed, "
+      "trace-joined), result-cache speedup %.0fx"
+      % (srv[0]["clients"], srv[0]["p99_ms"], srv[0]["shed_count"],
+         srv[0]["result_cache_speedup"]))
 '
 
 # Prometheus exposition: one local scrape through tools/srjt_export.py,

@@ -390,6 +390,16 @@ class BridgeClient:
         (h,) = struct.unpack("<Q", self._call(P.OP_READ_PARQUET, body))
         return h
 
+    def serving_stats(self) -> dict:
+        """Multi-tenant serving snapshot: the scheduler block (live /
+        admitted / queued / shed sessions, fair-share rounds) and the
+        result-set cache block (hits / misses / evictions) from
+        OP_METRICS.  Empty dicts before the server's first PLAN_EXECUTE
+        (the engine — and with it the scheduler — loads lazily)."""
+        m = self.metrics()
+        return {"scheduler": m.get("scheduler", {}),
+                "result_cache": m.get("result_cache", {})}
+
     def execute_plan(self, plan) -> list[int]:
         """Run a whole engine plan in ONE round-trip; returns table handles.
 
@@ -397,6 +407,12 @@ class BridgeClient:
         The server optimizes through its plan cache, executes, and replies
         with the result handle(s) — versus one ``_call`` per op for the
         same pipeline built from read_parquet/join/groupby/sort.
+
+        Under load the server may refuse to run the plan: a saturated
+        scheduler raises ``AdmissionRejectedError`` here (kind
+        ``resource``, deliberately NOT retryable — the client decides when
+        to come back), carrying the server-side ``trace_id`` and
+        post-mortem ``bundle_path`` like every other typed failure.
         """
         blob = bytes(plan) if isinstance(plan, (bytes, bytearray)) \
             else plan.serialize()

@@ -63,30 +63,41 @@ def _error_body(e: Exception, trace_id: str = "", bundle: str = "") -> bytes:
 
 
 class HandleTable:
-    """u64 id -> device object; the process-local analog of JNI jlong handles."""
+    """u64 id -> device object; the process-local analog of JNI jlong handles.
+
+    Internally locked: with PLAN_EXECUTE bodies running concurrently
+    (engine/scheduler.py) the table is written from many worker threads,
+    and ``put``'s id-allocate-then-store must be atomic or two sessions
+    could mint the same handle."""
 
     def __init__(self):
         self._next = 1
         self._objs: dict[int, object] = {}
+        self._lock = threading.Lock()
 
     def put(self, obj) -> int:
-        h = self._next
-        self._next += 1
-        self._objs[h] = obj
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._objs[h] = obj
         return h
 
     def get(self, h: int):
         try:
-            return self._objs[h]
+            with self._lock:
+                return self._objs[h]
         except KeyError:
             raise KeyError(f"invalid or released handle {h}") from None
 
     def release(self, h: int) -> None:
-        if self._objs.pop(h, None) is None:
+        with self._lock:
+            gone = self._objs.pop(h, None) is None
+        if gone:
             raise KeyError(f"invalid or released handle {h}")
 
     def live_count(self) -> int:
-        return len(self._objs)
+        with self._lock:
+            return len(self._objs)
 
 
 def _parse_columns(payload: bytes, off: int, ncols: int, buf) -> list[Column]:
@@ -141,18 +152,25 @@ class BridgeServer:
 
     A Spark executor JVM runs many task threads; the reference handles the
     matching concurrency with per-thread CUDA streams (reference pom.xml:80).
-    Here each connection gets a thread and ``_dispatch_lock`` serializes the
-    actual op execution — the handle table and export map are plain dicts,
-    and op work is one JAX dispatch anyway (XLA queues device work; slicing
-    the Python-side critical section thinner buys nothing).  What concurrency
-    buys: a slow client (mid-import, or idle) never blocks another client's
-    requests from being *accepted* and interleaved between its ops.
+    Here each connection gets a thread.  ``_dispatch_lock`` serializes the
+    *small* ops (handle plumbing, imports/exports, per-op engine shims) —
+    each is one JAX dispatch anyway, so slicing that critical section
+    thinner buys nothing.  PLAN_EXECUTE is the exception: whole plans run
+    for seconds and the engine below is concurrency-safe (locked caches,
+    per-query metrics contexts, the fair-share scheduler), so plan bodies
+    run OUTSIDE the dispatch lock on their connection threads and the
+    scheduler — not this lock — provides admission control and
+    interleaving.  OP_CANCEL / OP_QUERY_STATUS / OP_SHUTDOWN stay lock-free
+    in ``_client_loop`` as before.  The shared mutable state a concurrent
+    plan can touch (handle table, export map, op counters) is individually
+    locked.
     """
 
     def __init__(self, sock_path: str):
         self.sock_path = sock_path
         self.handles = HandleTable()
-        self._exports: dict[str, object] = {}  # shm name -> mmap
+        self._exports_lock = threading.Lock()
+        self._exports: dict[str, object] = {}  # shm name -> mmap (lock held)
         self._exp_counter = 0
         self._dispatch_lock = threading.Lock()
         self._shutdown = threading.Event()
@@ -166,6 +184,7 @@ class BridgeServer:
         self._active_tokens: dict[object, str] = {}
         # observability (SURVEY §5 metrics/logging): per-op counters the
         # client reads over OP_METRICS; slf4j-analog logger from utils.config
+        self._metrics_lock = threading.Lock()
         self._metrics = {"ops": {}, "errors": 0, "busy_s": 0.0}
         # lazily built on the first PLAN_EXECUTE (imports the engine)
         self._plan_cache = None
@@ -214,8 +233,10 @@ class BridgeServer:
         return struct.pack("<Q", self.handles.put(table))
 
     def _new_export_name(self) -> str:
-        self._exp_counter += 1
-        return f"tpub-exp-{os.getpid()}-{self._exp_counter}"
+        with self._exports_lock:
+            self._exp_counter += 1
+            n = self._exp_counter
+        return f"tpub-exp-{os.getpid()}-{n}"
 
     def _op_export_table(self, payload: bytes) -> bytes:
         (h,) = struct.unpack_from("<Q", payload)
@@ -225,7 +246,9 @@ class BridgeServer:
         name = self._new_export_name()
         exp = shmlib.SegmentWriter(name)
         descs = [_export_column_desc(exp, c) for c in table.columns]
-        self._exports[name] = exp.finish()
+        m = exp.finish()
+        with self._exports_lock:
+            self._exports[name] = m
         nameb = name.encode()
         return (struct.pack("<I", len(nameb)) + nameb +
                 struct.pack("<QI", exp.size, table.num_columns) +
@@ -242,7 +265,9 @@ class BridgeServer:
         ooff, olen = exp.add(np.asarray(col.offsets, np.int32).tobytes())
         child = col.children[0]
         doff, dlen = exp.add(np.asarray(child.data).tobytes())
-        self._exports[name] = exp.finish()
+        m = exp.finish()
+        with self._exports_lock:
+            self._exports[name] = m
         nameb = name.encode()
         return (struct.pack("<I", len(nameb)) + nameb +
                 struct.pack("<QqQQQQ", exp.size, col.size,
@@ -251,7 +276,8 @@ class BridgeServer:
     def _op_free_shm(self, payload: bytes) -> bytes:
         (nlen,) = struct.unpack_from("<I", payload, 0)
         name = payload[4:4 + nlen].decode()
-        m = self._exports.pop(name, None)
+        with self._exports_lock:
+            m = self._exports.pop(name, None)
         if m is not None:
             m.close()
         shmlib.unlink(name)
@@ -437,6 +463,17 @@ class BridgeServer:
         client's trace scope (``trace_id`` from the v2 frame header, or a
         server-minted one for v1 clients) so server spans, the flight
         recorder, and any post-mortem bundle all join on the client's id.
+
+        Multi-tenant serving (engine/scheduler.py): this op runs OUTSIDE
+        ``_dispatch_lock``, so N clients execute plans concurrently.  The
+        path through here is, in order: (1) result-set cache — a repeat of
+        a finished plan over unchanged input files serves the cached table
+        without touching the scheduler or the executor; (2) SLO-aware
+        admission — ``SCHEDULER.admit`` queues or sheds
+        (``AdmissionRejectedError``) when ``SRJT_MAX_SESSIONS`` sessions
+        are live; (3) execution with the admitted ``QuerySession`` threaded
+        through ``RecoveryPolicy``, so every chunk boundary is a fair-share
+        gate and OOM consults the session budget first.
         """
         (plen,) = struct.unpack_from("<I", payload)
         blob = payload[4:4 + plen]
@@ -466,15 +503,44 @@ class BridgeServer:
             tok = CancelToken(_cfg.query_timeout_s or None)
             with self._tokens_lock:
                 self._active_tokens[tok] = scope.trace_id
+            fp = plan.fingerprint()
             try:
-                # plan-cache lookup runs inside the query context so its
-                # hit/miss is attributed to the query that caused it
-                # (OP_METRICS `queries`)
-                with metrics.query(f"plan:{plan.fingerprint()[:12]}") as qm:
+                # plan-cache / result-cache lookups run inside the query
+                # context so their hits/misses are attributed to the query
+                # that caused them (OP_METRICS `queries`)
+                with metrics.query(f"plan:{fp[:12]}") as qm:
                     if qm is not None:
                         qm.trace_id = scope.trace_id
-                    compiled = self._plan_cache.get(plan)
-                    out = compiled.execute(stats=stats, cancel=tok)
+                        # stamp the submitted-plan fingerprint so persisted
+                        # profiles key SLO burn by plan, not "(none)" — the
+                        # admission controller's shed signal depends on it
+                        qm.fingerprint = fp
+                        qm.source_fingerprint = fp
+                    out, version = None, None
+                    from ..engine.cache import RESULT_CACHE, data_version
+                    if RESULT_CACHE.enabled:
+                        # before admission on purpose: a cache hit costs no
+                        # device work, so it serves even when the scheduler
+                        # would queue or shed a real execution
+                        version = data_version(plan)
+                        out = RESULT_CACHE.get(fp, version)
+                        if out is not None:
+                            stats["served_from_cache"] = True
+                    if out is None:
+                        session = None
+                        if _cfg.sched:
+                            from ..engine.scheduler import SCHEDULER
+                            session = SCHEDULER.admit(
+                                fingerprint=fp, trace_id=scope.trace_id)
+                        try:
+                            compiled = self._plan_cache.get(plan)
+                            out = compiled.execute(stats=stats, cancel=tok,
+                                                   session=session)
+                        finally:
+                            if session is not None:
+                                session.release()
+                        if RESULT_CACHE.enabled and version is not None:
+                            RESULT_CACHE.put(fp, version, out)
                     if qm is not None:
                         qm.note_stats(stats)
             finally:
@@ -559,16 +625,25 @@ class BridgeServer:
         # don't ship the whole registry.  Empty payload = everything,
         # byte-compatible with pre-prefix clients.
         prefix = payload.decode("utf-8") if payload else ""
-        snap = {"ops": dict(self._metrics["ops"]),
-                "errors": self._metrics["errors"],
-                "busy_s": round(self._metrics["busy_s"], 6),
-                "live_handles": self.handles.live_count(),
-                "open_exports": len(self._exports)}
+        with self._metrics_lock:
+            snap = {"ops": dict(self._metrics["ops"]),
+                    "errors": self._metrics["errors"],
+                    "busy_s": round(self._metrics["busy_s"], 6)}
+        snap["live_handles"] = self.handles.live_count()
+        with self._exports_lock:
+            snap["open_exports"] = len(self._exports)
         if self._plan_cache is not None:
             snap["plan_cache"] = self._plan_cache.stats()
             snap["last_plan"] = dict(self._last_plan_stats)
             if self._last_plan_summary:
                 snap["last_plan_summary"] = dict(self._last_plan_summary)
+            # serving state: who is live/queued/shed, and whether repeat
+            # queries are being served from the result-set cache — only
+            # populated once the engine is imported (first PLAN_EXECUTE)
+            from ..engine.cache import RESULT_CACHE
+            from ..engine.scheduler import SCHEDULER
+            snap["scheduler"] = SCHEDULER.stats()
+            snap["result_cache"] = RESULT_CACHE.stats()
         # engine-wide observability: the flat monotonic counters plus the
         # SRJT_METRICS layer (histograms as [le, count] pairs, gauges, and
         # recent per-query summaries) — all JSON-native by construction
@@ -640,7 +715,9 @@ class BridgeServer:
                 os.unlink(self.sock_path)
             except FileNotFoundError:
                 pass
-            for name, m in self._exports.items():
+            with self._exports_lock:
+                leftover = list(self._exports.items())
+            for name, m in leftover:
                 try:
                     m.close()
                     shmlib.unlink(name)
@@ -730,14 +807,24 @@ class BridgeServer:
                         pass
                     return
                 try:
-                    with self._dispatch_lock:
-                        t0 = time.perf_counter()
+                    t0 = time.perf_counter()
+                    if opcode == P.OP_PLAN_EXECUTE:
+                        # the concurrent path: plan bodies run for seconds
+                        # and the engine below is concurrency-safe, so N
+                        # sessions execute in parallel on their connection
+                        # threads — the scheduler (admission + fair-share
+                        # gates), not this lock, arbitrates between them
                         out = self._dispatch(opcode, payload, tid)
+                    else:
+                        with self._dispatch_lock:
+                            out = self._dispatch(opcode, payload, tid)
+                    with self._metrics_lock:
                         ops = self._metrics["ops"]
                         ops[opcode] = ops.get(opcode, 0) + 1
                         self._metrics["busy_s"] += time.perf_counter() - t0
                 except Exception as e:  # noqa: BLE001 — CATCH_STD analog
-                    self._metrics["errors"] += 1
+                    with self._metrics_lock:
+                        self._metrics["errors"] += 1
                     self._log.warning("op %d failed: %s: %s", opcode,
                                       type(e).__name__, e)
                     # post-mortem before replying: the executor's own

@@ -14,7 +14,11 @@ per execution (``BUILD_CACHE``, cache.py) and enters the chunk program as
 a pytree input; ``Limit(Sort(...))`` fuses into a ``TopK`` node executed
 as a per-chunk partial top-k over order-preserving u64 keys.  ``PlanCache``
 (cache.py) lets repeat queries skip optimization and hit the warm jit
-caches.
+caches.  Under concurrent serving (scheduler.py) N sessions run at once:
+an SLO-aware admission controller queues or sheds past ``SRJT_MAX_SESSIONS``,
+a deficit-round-robin gate interleaves their chunks at recovery
+checkpoints, and ``RESULT_CACHE`` (cache.py) serves repeat plans over
+unchanged input files without executing at all — ``docs/SERVING.md``.
 ``docs/ENGINE.md`` has the full design, including the bridge's one-message
 ``PLAN_EXECUTE`` wire format.
 """
@@ -45,9 +49,17 @@ from .verify import (  # noqa: F401
 from .executor import execute, new_stats  # noqa: F401
 from .cache import (  # noqa: F401
     BUILD_CACHE,
+    RESULT_CACHE,
     BuildCache,
     CompiledPlan,
     PlanCache,
+    ResultCache,
+    data_version,
+)
+from .scheduler import (  # noqa: F401
+    SCHEDULER,
+    QuerySession,
+    Scheduler,
 )
 from .explain import ExplainReport, explain_analyze  # noqa: F401
 from .segment import (  # noqa: F401

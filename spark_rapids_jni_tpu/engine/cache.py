@@ -23,6 +23,7 @@ repeat execution over the same-shaped build — instead of once per chunk.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Optional
@@ -30,7 +31,7 @@ from typing import Optional
 from ..utils import metrics
 from .executor import execute
 from .optimizer import optimize
-from .plan import PlanNode
+from .plan import PlanNode, Scan
 
 
 class CompiledPlan:
@@ -44,9 +45,11 @@ class CompiledPlan:
         self.optimized = optimized
         self.executions = 0
 
-    def execute(self, stats: Optional[dict] = None, cancel=None):
+    def execute(self, stats: Optional[dict] = None, cancel=None,
+                session=None):
         self.executions += 1
-        return execute(self.optimized, stats=stats, cancel=cancel)
+        return execute(self.optimized, stats=stats, cancel=cancel,
+                       session=session)
 
 
 class PlanCache:
@@ -189,3 +192,118 @@ class BuildCache:
 
 #: process-wide prepared-build cache (the streamed-join prep layer)
 BUILD_CACHE = BuildCache()
+
+
+def data_version(plan: PlanNode):
+    """Freshness key for the result-set cache: the sorted
+    ``(path, mtime_ns, size)`` tuple over every ``Scan`` leaf.
+
+    A rewritten input file changes its mtime (and usually size), so the
+    composite key ``(plan fingerprint, data_version)`` misses — the cache
+    never serves stale rows; it only skips re-reading data that has not
+    moved.  Returns ``None`` (uncacheable) when any input can't be
+    stat'ed — a vanishing file should fail in the scan, not be masked by
+    a stale cached result.
+    """
+    paths = set()
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, Scan):
+            paths.add(n.path)
+        stack.extend(n.children())
+    version = []
+    for p in sorted(paths):
+        try:
+            st = os.stat(p)
+        except OSError:
+            return None
+        version.append((p, st.st_mtime_ns, st.st_size))
+    return tuple(version)
+
+
+class ResultCache:
+    """LRU: (plan fingerprint, data version) -> completed result table.
+
+    The fourth — and cheapest — cache layer: where ``PlanCache`` skips
+    optimization and ``SegmentCache`` skips compilation, this skips the
+    *execution*.  Off by default (``SRJT_RESULT_CACHE=0``): serving
+    deployments opt in, and plan-cache contract tests keep observing real
+    executions.  Keys carry the input files' identity (``data_version``)
+    so a repeat query is served only while its data is bit-identical on
+    disk.  Counters ``engine.result_cache.{hit,miss,eviction}`` attribute
+    per query like every other cache; capacity is entries, resolved per
+    use so ``refresh()`` retunes live servers.
+
+    ``get``/``put`` are split (unlike the builder-callback caches)
+    because the execution between them runs under the caller's session,
+    cancel token, and stats plumbing; a concurrent-miss race on ``put``
+    keeps the first-stored result.
+    """
+
+    def __init__(self, maxsize: Optional[int] = None):
+        self._maxsize = None if maxsize is None else int(maxsize)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def maxsize(self) -> int:
+        from ..utils.config import config
+        return self._maxsize if self._maxsize is not None \
+            else config.result_cache
+
+    @property
+    def enabled(self) -> bool:
+        return self.maxsize > 0
+
+    def get(self, fingerprint: str, version):
+        """The cached result for ``(fingerprint, version)`` or ``None``;
+        an unstattable ``version`` (None) never hits and never counts."""
+        if version is None or not self.enabled:
+            return None
+        key = (fingerprint, version)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                metrics.count("engine.result_cache.hit")
+                return hit
+            self.misses += 1
+            metrics.count("engine.result_cache.miss")
+            return None
+
+    def put(self, fingerprint: str, version, result) -> None:
+        if version is None or not self.enabled or result is None:
+            return
+        key = (fingerprint, version)
+        with self._lock:
+            if key in self._entries:  # concurrent miss: first store wins
+                self._entries.move_to_end(key)
+                return
+            self._entries[key] = result
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                metrics.count("engine.result_cache.eviction")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "size": len(self._entries), "maxsize": self.maxsize}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: process-wide result-set cache (the skip-the-execution layer)
+RESULT_CACHE = ResultCache()

@@ -333,6 +333,16 @@ def _exec_aggregate(node: Aggregate, memo: dict, stats: dict,
         _precompute_independent(node.child, scan, memo, stats, ctx)
         snap = {k: (list(v) if isinstance(v, list) else v)
                 for k, v in stats.items()}
+
+        def restore():
+            # drop a failed attempt's partial evidence (chunks, row-group
+            # counts, fused_segments, chain nodes) so the re-run's
+            # accounting isn't double-counted; lists re-copied so a
+            # second restore starts from the clean snapshot too
+            stats.clear()
+            stats.update({k: (list(v) if isinstance(v, list) else v)
+                          for k, v in snap.items()})
+
         try:
             return _exec_streamed(node, scan, memo, stats, ctx)
         except Exception as e:
@@ -342,11 +352,17 @@ def _exec_aggregate(node: Aggregate, memo: dict, stats: dict,
             # staged double-buffering of device chunks)
             if not ctx.recovery.can_degrade(e):
                 raise
-            # drop the failed attempt's partial evidence (chunks,
-            # row-group counts, fused_segments, chain nodes) so the
-            # re-run's accounting isn't double-counted
-            stats.clear()
-            stats.update(snap)
+            restore()
+            if ctx.recovery.oom_retry_first("stream.fused", e):
+                # session within its own budget: the pressure was a
+                # neighbor's — one same-rung retry before degrading
+                try:
+                    return _exec_streamed(node, scan, memo, stats, ctx)
+                except Exception as e2:
+                    if not ctx.recovery.can_degrade(e2):
+                        raise
+                    restore()
+                    e = e2
             ctx.recovery.degrade("stream-interpreted", e, stats)
             return _exec_streamed(node, scan, memo, stats, ctx,
                                   force_interp=True)
@@ -417,6 +433,16 @@ def _exec_exchange(node: Exchange, memo: dict, stats: dict,
     except Exception as e:
         if not rp.can_degrade(e):
             raise
+        if rp.oom_retry_first("exchange.dispatch", e):
+            # the session's own footprint fits its budget, so this OOM is
+            # neighbor pressure — one full-capacity retry before stepping
+            # down (the old behavior resumes if it fails again)
+            try:
+                return _hash_exchange(node, child, ctx, stats)
+            except Exception as e2:
+                if not rp.can_degrade(e2):
+                    raise
+                e = e2
         rp.degrade("exchange-halved", e, stats)
     try:
         return _hash_exchange(node, child, ctx, stats,
@@ -709,8 +735,13 @@ def _spilled_exchange(node: Exchange, table: Table, ctx: _ExecCtx) -> Table:
         key_specs = sh.key_specs_for(table, keys, plan)
     # half the table's footprint as the pass budget: small exchanges run
     # one pass, oversize ones split — the degraded path exists because the
-    # full-capacity dispatch just OOMed, so never size to the whole table
+    # full-capacity dispatch just OOMed, so never size to the whole table.
+    # A session memory budget clamps further: one tenant's spill ladder
+    # must not size its passes as if it owned the whole device
     budget = max(1 << 20, table_nbytes(table) // 2)
+    srem = ctx.recovery.session_budget_remaining()
+    if srem is not None:
+        budget = max(1 << 20, min(budget, srem))
     metrics.count("engine.exchange.spilled_reroutes")
     result = shuffle_table_spilled(table, make_mesh(ndev), keys,
                                    hbm_budget_bytes=budget,
@@ -866,6 +897,7 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                         if first is not None else ():
                     ctx.recovery.checkpoint()
                     stats["chunks"] += 1
+                    ctx.recovery.charge(table_nbytes(chunk))
                     tc0 = time.perf_counter() if qm is not None else 0.0
                     if fused:  # chunks after the first hit the cache
                         preps = _get_builds(joins, build_tables)
@@ -948,6 +980,7 @@ def _stream_partial(agg: Aggregate, scan: Scan, chunk: Table, memo: dict,
     """Interpreted per-chunk partial: re-walk the scan-dependent subtree
     with the chunk standing in for the scan, then a compacting groupby."""
     stats["chunks"] += 1
+    ctx.recovery.charge(table_nbytes(chunk))
     qm = metrics.current()
     tc0 = time.perf_counter() if qm is not None else 0.0
     sub = _ChunkMemo(memo)
@@ -1014,6 +1047,7 @@ def _exec_topk(node: TopK, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
         for chunk in reader:
             ctx.recovery.checkpoint()
             stats["chunks"] += 1
+            ctx.recovery.charge(table_nbytes(chunk))
             tc0 = time.perf_counter() if qm is not None else 0.0
             if qm is not None:
                 cb = table_nbytes(chunk)
@@ -1108,7 +1142,8 @@ def _stamp_plan_feedback(plan: PlanNode, qm) -> None:
 def execute(plan: PlanNode, stats: Optional[dict] = None,
             fused: Optional[bool] = None,
             prefetch: Optional[int] = None,
-            cancel: Optional[CancelToken] = None) -> Table:
+            cancel: Optional[CancelToken] = None,
+            session=None) -> Table:
     """Run ``plan`` against the local io/ops layers; returns the result.
 
     ``stats`` (optional dict) is updated in place with execution evidence:
@@ -1127,6 +1162,13 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
     through the readers' ``close()`` machinery.  With no token given,
     ``SRJT_QUERY_TIMEOUT_S > 0`` installs a deadline-only token.
 
+    ``session`` (engine.scheduler.QuerySession, optional) makes the
+    execution a scheduled tenant: chunk boundaries become fair-share
+    scheduling points, chunk bytes charge the session's memory budget,
+    and the OOM ladder consults that budget before degrading
+    (engine/recovery.py ``oom_retry_first``).  Unscheduled executions
+    behave exactly as before.
+
     Failures are classified (utils.errors) on the way out: the query
     summary carries an ``outcome`` record and ``engine.errors.<kind>``
     ticks — EXPLAIN ANALYZE and the profile store render both.
@@ -1139,7 +1181,7 @@ def execute(plan: PlanNode, stats: Optional[dict] = None,
             stats.setdefault(k, v)
     if cancel is None:
         cancel = query_cancel_token()
-    recovery = RecoveryPolicy(cancel=cancel)
+    recovery = RecoveryPolicy(cancel=cancel, session=session)
     ctx = _ExecCtx(plan,
                    fuse=config.fuse if fused is None else bool(fused),
                    prefetch=config.prefetch if prefetch is None

@@ -279,7 +279,8 @@ def _render(root: PlanNode, spans: dict,
 def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
                     fused: Optional[bool] = None,
                     prefetch: Optional[int] = None,
-                    distribute: Optional[bool] = None) -> ExplainReport:
+                    distribute: Optional[bool] = None,
+                    result_cache: bool = False) -> ExplainReport:
     """Optimize + execute ``plan`` and report per-node metrics.
 
     ``fused``/``prefetch`` pass through to ``execute`` (so both executor
@@ -288,6 +289,16 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
     exchange telemetry render in the same report).  With ``SRJT_METRICS=0``
     the plan still runs and the tree still renders, but node annotations
     and the summary are empty.
+
+    ``result_cache=True`` routes through the result-set cache
+    (``engine.cache.RESULT_CACHE``, active only when ``SRJT_RESULT_CACHE``
+    sets a capacity): a repeat of this plan over unchanged input files
+    serves the cached table without executing, and the report says so —
+    a ``serving:result_cache choice=served_from_cache`` line in the
+    footer and a matching entry in ``report.decisions``.  The serving
+    entry is deliberately NOT stamped on ``plan._decisions``: the
+    optimizer ledger must keep equaling ``verify.decision_census`` (it
+    describes plan structure, not how a particular call was served).
     """
     from .executor import execute, new_stats
     from .optimizer import optimize
@@ -296,13 +307,30 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
     if stats is None:
         stats = new_stats()
     qm = None
+    serving: list = []
     with metrics.query(f"explain:{node_label(opt)}") as q:
         qm = q
         if q is not None:
             from ..utils.config import config
             if config.profile_dir:
                 q.fingerprint = opt.fingerprint()
-        out = execute(opt, stats, fused=fused, prefetch=prefetch)
+        out = version = None
+        if result_cache:
+            from .cache import RESULT_CACHE, data_version
+            if RESULT_CACHE.enabled:
+                fp = opt.fingerprint()
+                version = data_version(opt)
+                out = RESULT_CACHE.get(fp, version)
+                if out is not None:
+                    stats["served_from_cache"] = True
+                    serving.append({"kind": "serving:result_cache",
+                                    "choice": "served_from_cache",
+                                    "fingerprint": fp[:12]})
+        if out is None:
+            out = execute(opt, stats, fused=fused, prefetch=prefetch)
+            if version is not None:
+                from .cache import RESULT_CACHE
+                RESULT_CACHE.put(opt.fingerprint(), version, out)
         if q is not None:
             q.note_stats(stats)
     spans = dict(qm.node_spans) if qm is not None else {}
@@ -361,8 +389,15 @@ def explain_analyze(plan: PlanNode, stats: Optional[dict] = None,
             foot.append(f"-- decisions ({len(decisions)}):")
             for d in decisions:
                 foot.append("--   " + _decision_line(d, actuals))
+        if serving:
+            # how THIS call was served (cache hit), kept out of the
+            # optimizer ledger so ledger == decision_census still holds
+            foot.append(f"-- serving ({len(serving)}):")
+            for d in serving:
+                foot.append("--   " + _decision_line(d, {}))
         text = text + "\n" + "\n".join(foot)
     return ExplainReport(text=text, nodes=nodes, summary=summary,
                          result=out,
                          decisions=[dict(d) for d in
-                                    getattr(opt, "_decisions", None) or ()])
+                                    getattr(opt, "_decisions", None) or ()] +
+                         serving)

@@ -24,6 +24,15 @@ loop (``_ExecCtx``).  It owns three behaviors, each bounded and each loud:
    the bridge CANCEL opcode) checked at chunk boundaries and polled in the
    prefetch producer; raises ``QueryCancelledError``/``QueryTimeoutError``
    and unwinds through the existing ``close()`` machinery.
+
+Under multi-tenancy a fourth concern rides along: the policy carries the
+query's :class:`~..engine.scheduler.QuerySession`, so every chunk
+boundary is also a fair-share scheduling point (``session.gate()``), and
+the OOM ladder consults the SESSION budget before the global memory
+picture — a tenant within its own budget that hits RESOURCE_EXHAUSTED is
+feeling a neighbor's pressure, and gets one same-rung retry
+(``oom_retry_first``) instead of being force-degraded for someone else's
+allocation storm.
 """
 
 from __future__ import annotations
@@ -43,17 +52,21 @@ __all__ = ["RecoveryPolicy", "CancelToken", "QueryCancelledError",
 class RecoveryPolicy:
     """Per-query retry/degradation policy + cancellation token carrier."""
 
-    __slots__ = ("retry_max", "backoff_s", "cancel", "degradations")
+    __slots__ = ("retry_max", "backoff_s", "cancel", "session",
+                 "degradations", "_oom_retries")
 
     def __init__(self, cancel: Optional[CancelToken] = None,
                  retry_max: Optional[int] = None,
-                 backoff_s: Optional[float] = None):
+                 backoff_s: Optional[float] = None,
+                 session=None):
         self.retry_max = (config.retry_max if retry_max is None
                           else int(retry_max))
         self.backoff_s = (config.retry_backoff_s if backoff_s is None
                           else float(backoff_s))
         self.cancel = cancel
+        self.session = session
         self.degradations: list[dict] = []
+        self._oom_retries: set[str] = set()
 
     # -- retry ---------------------------------------------------------------
 
@@ -65,9 +78,28 @@ class RecoveryPolicy:
     # -- cancellation --------------------------------------------------------
 
     def checkpoint(self) -> None:
-        """Chunk-boundary cancellation/deadline check (no-op untokened)."""
+        """Chunk-boundary cancellation/deadline check — and, with a
+        session attached, the fair-share scheduling point (no-op when
+        untokened and unscheduled)."""
         if self.cancel is not None:
             self.cancel.check()
+        if self.session is not None:
+            self.session.gate()
+
+    # -- session memory budget -----------------------------------------------
+
+    def charge(self, nbytes: int) -> None:
+        """Charge a chunk's bytes against the session budget (no-op
+        without a session) — called from the executor's existing
+        ``table_nbytes`` sites, so tracking adds no device syncs."""
+        if self.session is not None:
+            self.session.charge(nbytes)
+
+    def session_budget_remaining(self) -> Optional[int]:
+        """Remaining session budget in bytes; ``None`` = unbudgeted."""
+        if self.session is None:
+            return None
+        return self.session.budget_remaining()
 
     # -- degradation ---------------------------------------------------------
 
@@ -75,6 +107,39 @@ class RecoveryPolicy:
         """Only resource exhaustion walks the ladder; transient failures
         are the retry layer's job and cancellation/fatal propagate."""
         return is_resource_exhausted(exc)
+
+    def oom_retry_first(self, site: str, exc: BaseException) -> bool:
+        """Should this OOM retry the SAME rung once before degrading?
+
+        The pre-concurrency ladder consulted only the global memory
+        picture, so ANY resource exhaustion stepped the query down —
+        even when the allocation pressure came from a neighboring
+        session's transient spike.  With a session budget attached the
+        call is better informed: a session still WITHIN its own budget
+        did not earn this OOM, so it deserves one same-rung retry after
+        the neighbor's chunk retires (counted as
+        ``engine.sched.neighbor_pressure``).  A session over its budget
+        — or an unbudgeted/unscheduled query — degrades immediately,
+        exactly the old behavior.  One retry per site per query: if the
+        pressure persists, the ladder proceeds."""
+        if self.session is None or not is_resource_exhausted(exc):
+            return False
+        if self.session.over_budget() or self.session.budget_bytes <= 0:
+            return False
+        if site in self._oom_retries:
+            return False
+        self._oom_retries.add(site)
+        metrics.count("engine.sched.neighbor_pressure")
+        from ..utils import blackbox
+        blackbox.record("neighbor_pressure", site=site,
+                        trace_id=self.session.trace_id,
+                        peak_chunk_bytes=self.session.peak_chunk_bytes,
+                        budget_bytes=self.session.budget_bytes)
+        logger().warning(
+            "OOM at %s within session budget (%d/%d peak bytes): "
+            "retrying same rung once before degrading", site,
+            self.session.peak_chunk_bytes, self.session.budget_bytes)
+        return True
 
     def degrade(self, step: str, exc: BaseException,
                 stats: Optional[dict] = None) -> None:

@@ -380,6 +380,32 @@ def _objective_for(fp: str, default_ms, per: dict):
     return default_ms
 
 
+def slo_objective_for(fp: str):
+    """The latency objective (ms) that applies to source fingerprint
+    ``fp``, or ``None`` when no SLO covers it.  The scheduler derives a
+    session's fair-share weight from this (tighter objective -> more
+    chunks per round)."""
+    default_ms, per = slo_targets()
+    return _objective_for(fp[:12], default_ms, per)
+
+
+def slo_burn_for(fp: str, dir_path: str | None = None):
+    """Windowed burn rate for source fingerprint ``fp`` from the profile
+    store, or ``None`` when SLOs are off or the fingerprint has no
+    history.  This is the admission controller's shed signal
+    (engine/scheduler.py): a fingerprint already burning its error
+    budget is shed when the server saturates, instead of queueing
+    behind queries that still have budget to protect."""
+    rep = slo_report(dir_path)
+    if not rep.get("enabled"):
+        return None
+    p = fp[:12]
+    for e in rep["entries"]:
+        if e["fingerprint"] == p:
+            return e["burn_rate"]
+    return None
+
+
 def slo_report(dir_path: str | None = None) -> dict:
     """Per-source-fingerprint SLO burn from profile-store history.
 

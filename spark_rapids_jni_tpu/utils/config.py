@@ -42,6 +42,12 @@ environment flags read once at import:
 | ``SRJT_SLO_MS``       | *(unset)* | latency objectives: ``default_ms[,fp12=ms,...]`` per source fingerprint, evaluated from the profile store (utils/blackbox.py slo_report) |
 | ``SRJT_TRACE_ID``     | *(unset)* | inherited trace context for helper processes (bench dist subprocess); minted per client/query when empty |
 | ``SRJT_ROOFLINE_GBPS`` | ``0`` | device-bandwidth ceiling override for explain-analyze roofline fractions (0 = use BENCH_BASELINES.json pin) |
+| ``SRJT_SCHED``        | ``1``   | multi-tenant scheduler (engine/scheduler.py): SLO-aware admission + fair-share chunk interleaving on the bridge PLAN_EXECUTE path |
+| ``SRJT_MAX_SESSIONS`` | ``8``   | concurrent admitted PLAN_EXECUTE sessions; arrivals past this queue at admission |
+| ``SRJT_ADMISSION_QUEUE_S`` | ``5.0`` | max seconds a query waits in the admission queue before it is shed (AdmissionRejectedError) |
+| ``SRJT_ADMISSION_BURN`` | ``0.9`` | SLO burn rate (breaches/runs from the profile store) at or above which a saturated server sheds the fingerprint immediately instead of queueing |
+| ``SRJT_SESSION_BUDGET_BYTES`` | ``0`` | per-session device-memory budget charged at chunk boundaries (0 = unlimited; bounds the spill ladder and gates the OOM retry-first path) |
+| ``SRJT_RESULT_CACHE`` | ``0``   | result-set cache capacity (entries) keyed (plan fingerprint, data version); 0 = off |
 | ``JAX_PLATFORMS``     | *(unset)* | jax platform list honored by the bridge server before its first jax touch |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
@@ -123,6 +129,12 @@ class Config:
     trace_id: str = ""           # inherited trace context (subprocesses)
     roofline_gbps: float = 0.0   # explain-analyze ceiling override (0=pin)
     jax_platforms: str = ""      # jax platform list ("" = jax's default)
+    sched: bool = True           # multi-tenant scheduler (engine/scheduler)
+    max_sessions: int = 8        # concurrent admitted PLAN_EXECUTE sessions
+    admission_queue_s: float = 5.0  # admission-queue wait bound (seconds)
+    admission_burn: float = 0.9  # burn rate that sheds when saturated
+    session_budget_bytes: int = 0  # per-session memory budget (0=unlimited)
+    result_cache: int = 0        # result-set cache capacity (0 = off)
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -165,6 +177,12 @@ class Config:
             trace_id=os.environ.get("SRJT_TRACE_ID", "").strip(),
             roofline_gbps=_float_flag("SRJT_ROOFLINE_GBPS", 0.0),
             jax_platforms=os.environ.get("JAX_PLATFORMS", "").strip(),
+            sched=_bool_flag("SRJT_SCHED", True),
+            max_sessions=_int_flag("SRJT_MAX_SESSIONS", 8, minimum=1),
+            admission_queue_s=_float_flag("SRJT_ADMISSION_QUEUE_S", 5.0),
+            admission_burn=_float_flag("SRJT_ADMISSION_BURN", 0.9),
+            session_budget_bytes=_int_flag("SRJT_SESSION_BUDGET_BYTES", 0),
+            result_cache=_int_flag("SRJT_RESULT_CACHE", 0),
         )
 
 

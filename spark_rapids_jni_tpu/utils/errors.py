@@ -51,6 +51,19 @@ class ResourceExhaustedError(EngineError):
     retryable = False  # blind retry repeats the allocation; degrade instead
 
 
+class AdmissionRejectedError(EngineError):
+    """The scheduler shed this query at admission (engine/scheduler.py).
+
+    ``resource`` kind — the server is saturated, not broken — but NOT
+    retryable by the blind in-op retry loop: re-submitting immediately
+    would re-enter the same overloaded admission queue.  Clients decide
+    when (and whether) to come back; the wire doc carries trace_id and
+    the shed bundle pointer like every other typed failure."""
+
+    kind = KIND_RESOURCE
+    retryable = False
+
+
 class QueryCancelledError(EngineError):
     kind = KIND_CANCELLED
     retryable = False
@@ -104,6 +117,7 @@ def is_cancellation(exc: BaseException) -> bool:
 _WIRE_TYPES = {
     "TransientError": TransientError,
     "ResourceExhaustedError": ResourceExhaustedError,
+    "AdmissionRejectedError": AdmissionRejectedError,
     "QueryCancelledError": QueryCancelledError,
     "QueryTimeoutError": QueryTimeoutError,
     "BridgeTimeoutError": BridgeTimeoutError,

@@ -541,7 +541,15 @@ def progress_snapshot() -> list:
     ``engine.stream.chunk_latency_s`` p50 — the histogram the streaming
     loops already feed, so the estimate costs the READER a percentile
     walk and the running query nothing).  ``chunks_total`` is the footer
-    estimate (0 = no chunked stream opened yet)."""
+    estimate (0 = no chunked stream opened yet).
+
+    Entries carry a per-trace ``key`` (the trace id, or ``qid:<n>`` for
+    untraced queries): under multi-tenancy two concurrent sessions can
+    run the SAME plan — same name, same fingerprint — and a consumer
+    keying by either would merge their (independent) ETAs.  Every field
+    here, ETA included, is derived from the entry's own QueryMetrics, so
+    same-fingerprint sessions never contaminate each other; ``key``
+    makes that identity explicit for clients."""
     with _lock:
         live = list(_progress.values())
     out = []
@@ -551,6 +559,7 @@ def progress_snapshot() -> list:
             h = qm.hists.get("engine.stream.chunk_latency_s")
             p50 = _hist_percentiles(h, (0.5,))["p50"] if h else None
             entry = {"qid": qm.qid, "name": qm.name,
+                     "key": qm.trace_id or f"qid:{qm.qid}",
                      "fingerprint": qm.fingerprint,
                      "trace_id": qm.trace_id,
                      "wall_s": round(time.perf_counter() - qm.t0, 6),

@@ -1,0 +1,523 @@
+"""Multi-tenant serving: admission, fair share, result cache, budgets.
+
+The contracts under test (engine/scheduler.py + docs/SERVING.md):
+
+- admission admits up to SRJT_MAX_SESSIONS, queues past that (bounded by
+  SRJT_ADMISSION_QUEUE_S), and sheds with a *typed*
+  ``AdmissionRejectedError`` — immediately when the fingerprint's SLO
+  burn rate says the query would breach anyway;
+- the deficit-round-robin gate interleaves concurrent sessions' chunks
+  and never deadlocks, even when a credit holder stalls;
+- the engine caches are cross-session: N concurrent executions of the
+  same plan cost exactly ONE ``SEGMENT_CACHE`` miss, with hits/misses
+  attributed to the query that caused them;
+- the result-set cache serves repeats of a finished plan over unchanged
+  input files without executing, and invalidates on file change;
+- ``progress_snapshot`` keeps same-fingerprint concurrent sessions
+  apart (per-trace ``key``), so neither pollutes the other's ETA;
+- the OOM ladder consults the SESSION budget first: a within-budget
+  session gets one same-rung retry (neighbor pressure) where an
+  over-budget or unbudgeted one degrades exactly as before.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.engine import (Aggregate, Filter, Project, Scan,
+                                         col, execute, explain_analyze, lit,
+                                         optimize)
+from spark_rapids_jni_tpu.engine.plan import Exchange
+from spark_rapids_jni_tpu.engine.scheduler import (Scheduler,
+                                                   weight_for_objective)
+from spark_rapids_jni_tpu.utils import config as cfg
+from spark_rapids_jni_tpu.utils import faults, metrics
+from spark_rapids_jni_tpu.utils.errors import AdmissionRejectedError
+
+
+@pytest.fixture
+def warehouse(tmp_path):
+    n = 40_000
+    path = str(tmp_path / "fact.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array((np.arange(n) % 13).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    }), path, row_group_size=4096)
+    return path
+
+
+@pytest.fixture
+def serving_env(monkeypatch):
+    """Set serving knobs, refresh config; teardown restores the default."""
+    def _set(**env):
+        for k, v in env.items():
+            monkeypatch.setenv(k, str(v))
+        cfg.refresh()
+        faults.reset()
+    yield _set
+    for k in ("SRJT_MAX_SESSIONS", "SRJT_ADMISSION_QUEUE_S",
+              "SRJT_ADMISSION_BURN", "SRJT_SESSION_BUDGET_BYTES",
+              "SRJT_RESULT_CACHE", "SRJT_FAULTS", "SRJT_SLO_MS"):
+        monkeypatch.delenv(k, raising=False)
+    cfg.refresh()
+    faults.reset()
+
+
+# -- admission ----------------------------------------------------------------
+
+def test_admission_queue_then_admit(serving_env):
+    serving_env(SRJT_MAX_SESSIONS=1, SRJT_ADMISSION_QUEUE_S=10)
+    sched = Scheduler()
+    first = sched.admit(fingerprint="a" * 16, trace_id="t-hold")
+    got = {}
+
+    def queued():
+        s = sched.admit(fingerprint="b" * 16, trace_id="t-wait")
+        got["s"] = s
+        s.release()
+
+    t = threading.Thread(target=queued)
+    t.start()
+    time.sleep(0.15)           # let it queue against the full scheduler
+    assert "s" not in got      # still parked: one slot, one holder
+    first.release()
+    t.join(timeout=10)
+    assert got["s"].queued_s > 0.05
+    st = sched.stats()
+    assert st["admitted"] == 2 and st["queued"] == 1 and st["shed"] == 0
+
+
+def test_admission_shed_on_queue_timeout(serving_env):
+    serving_env(SRJT_MAX_SESSIONS=1, SRJT_ADMISSION_QUEUE_S=0.15)
+    sched = Scheduler()
+    hold = sched.admit(fingerprint="a" * 16, trace_id="t-hold")
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejectedError) as ei:
+        sched.admit(fingerprint="b" * 16, trace_id="t-shed")
+    assert time.monotonic() - t0 >= 0.1
+    # typed, resource-kind, and deliberately NOT blind-retryable
+    assert ei.value.kind == "resource" and ei.value.retryable is False
+    assert sched.stats()["shed"] == 1
+    hold.release()
+    # the shed event reached the flight recorder ring
+    from spark_rapids_jni_tpu.utils import blackbox
+    kinds = [e.get("ev") for e in blackbox.tail()]
+    assert "admission.shed" in kinds
+
+
+def test_admission_shed_immediately_on_slo_burn(serving_env, monkeypatch):
+    serving_env(SRJT_MAX_SESSIONS=1, SRJT_ADMISSION_QUEUE_S=30,
+                SRJT_ADMISSION_BURN=0.9)
+    from spark_rapids_jni_tpu.engine import scheduler as sched_mod
+    monkeypatch.setattr(sched_mod.blackbox, "slo_burn_for",
+                        lambda fp, dir_path=None: 1.0)
+    sched = Scheduler()
+    hold = sched.admit(fingerprint="a" * 16, trace_id="t-hold")
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejectedError, match="slo-burn"):
+        sched.admit(fingerprint="b" * 16, trace_id="t-burn")
+    # shed WITHOUT waiting out the 30s queue bound: burn-rate gated
+    assert time.monotonic() - t0 < 5.0
+    hold.release()
+
+
+# -- fair share ---------------------------------------------------------------
+
+def test_weight_for_objective():
+    assert weight_for_objective(None) == 1
+    assert weight_for_objective(0) == 1
+    assert weight_for_objective(250.0) == 8    # tight SLO -> big share
+    assert weight_for_objective(2000.0) == 1
+    assert weight_for_objective(1e9) == 1      # floor
+    assert weight_for_objective(1.0) == 8      # cap
+
+
+def test_fair_share_rounds_and_no_deadlock(serving_env):
+    serving_env(SRJT_MAX_SESSIONS=4)
+    sched = Scheduler()
+    sessions = [sched.admit(fingerprint=f"{i}" * 16, trace_id=f"t{i}")
+                for i in range(3)]
+    done = []
+
+    def spin(s, n):
+        for _ in range(n):
+            s.gate()
+        done.append(s.sid)
+        s.release()
+
+    # uneven chunk counts: early finishers release mid-round and the
+    # stragglers must still drain without a stuck round
+    ts = [threading.Thread(target=spin, args=(s, n))
+          for s, n in zip(sessions, (5, 60, 120))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(done) == [s.sid for s in sessions]
+    st = sched.stats()
+    assert st["live"] == 0
+    assert st["rounds"] >= 1   # >1 session forced at least one replenish
+
+
+def test_single_session_gate_is_free(serving_env):
+    serving_env(SRJT_MAX_SESSIONS=4)
+    sched = Scheduler()
+    s = sched.admit(fingerprint="a" * 16, trace_id="t-solo")
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        s.gate()
+    assert time.perf_counter() - t0 < 2.0   # fast path: no round machinery
+    assert sched.stats()["rounds"] == 0
+    s.release()
+
+
+# -- cross-session caches -----------------------------------------------------
+
+def test_segment_cache_one_miss_n_hits_across_sessions(warehouse,
+                                                       metrics_isolation):
+    """Satellite: N concurrent same-plan sessions cost exactly ONE
+    SEGMENT_CACHE miss; the per-query counters attribute each session's
+    own hit/miss (the flat counters and the attributions agree)."""
+    from spark_rapids_jni_tpu.engine import SEGMENT_CACHE
+    metrics_isolation("engine.segment_cache")
+    # non-streamed shape on purpose: a Filter->Project segment compiles
+    # via one SEGMENT_CACHE.get per execution (executor._exec_segment),
+    # so hit/miss counts are exact; fused streaming loops get() per CHUNK
+    plan = optimize(Project(Filter(Scan(warehouse),
+                                   (">", col("v"), lit(10))), ["v"]))
+    SEGMENT_CACHE.clear()
+    n = 3
+    summaries = [None] * n
+    barrier = threading.Barrier(n)
+
+    def run(i):
+        with metrics.query(f"sess{i}") as qm:
+            barrier.wait(timeout=30)
+            execute(plan)
+            summaries[i] = qm.summary() if qm is not None else {}
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    per_q = [(s["counters"].get("engine.segment_cache.miss", 0),
+              s["counters"].get("engine.segment_cache.hit", 0))
+             for s in summaries]
+    # exactly one session stored (first-store-wins), everyone else hit —
+    # racers that compiled in parallel still count as hits by design
+    assert sum(m for m, _ in per_q) == 1
+    assert sum(h for _, h in per_q) == n - 1
+    assert all(m + h >= 1 for m, h in per_q)   # every session attributed
+    from spark_rapids_jni_tpu.utils import tracing
+    snap = tracing.counters_snapshot("engine.segment_cache")
+    assert snap.get("engine.segment_cache.miss") == 1
+    assert snap.get("engine.segment_cache.hit") == n - 1
+
+
+# -- result-set cache ---------------------------------------------------------
+
+def test_result_cache_disabled_by_default():
+    from spark_rapids_jni_tpu.engine import RESULT_CACHE
+    assert cfg.config.result_cache == 0
+    assert not RESULT_CACHE.enabled
+
+
+def test_result_cache_hit_and_invalidation(warehouse, serving_env):
+    serving_env(SRJT_RESULT_CACHE=8)
+    from spark_rapids_jni_tpu.engine import RESULT_CACHE
+    RESULT_CACHE.clear()
+    plan = Aggregate(Scan(warehouse), ["k"], [("v", "sum")], names=["s"])
+    r1 = explain_analyze(plan, result_cache=True)
+    before = RESULT_CACHE.stats()
+    r2 = explain_analyze(plan, result_cache=True)
+    after = RESULT_CACHE.stats()
+    assert after["hits"] == before["hits"] + 1
+    # the serving decision is ledgered in the report AND the rendered text
+    assert any(d["kind"] == "serving:result_cache" and
+               d["choice"] == "served_from_cache" for d in r2.decisions)
+    assert "served_from_cache" in r2.text
+    # ...but NOT stamped on the optimizer ledger (ledger == census holds)
+    assert not any(d["kind"] == "serving:result_cache" for d in r1.decisions)
+    # identical bytes: the cached table IS the computed table
+    for c1, c2 in zip(r1.result.columns, r2.result.columns):
+        np.testing.assert_array_equal(np.asarray(c1.data), np.asarray(c2.data))
+    # data-version invalidation: touching the input file changes the key
+    time.sleep(0.02)
+    t = pq.read_table(warehouse)
+    pq.write_table(t.slice(0, 1000), warehouse)
+    r3 = explain_analyze(plan, result_cache=True)
+    assert not any(d["kind"] == "serving:result_cache" for d in r3.decisions)
+    assert r3.result is not r2.result
+
+
+def test_result_cache_lru_eviction(warehouse, serving_env):
+    serving_env(SRJT_RESULT_CACHE=1)
+    from spark_rapids_jni_tpu.engine import RESULT_CACHE, data_version
+    RESULT_CACHE.clear()
+    opt = optimize(Filter(Scan(warehouse), (">", col("v"), lit(0))))
+    ver = data_version(opt)
+    assert ver is not None
+    RESULT_CACHE.put("fp-one", ver, "r1")
+    RESULT_CACHE.put("fp-two", ver, "r2")
+    assert len(RESULT_CACHE) == 1
+    assert RESULT_CACHE.stats()["evictions"] == 1
+    assert RESULT_CACHE.get("fp-one", ver) is None
+    assert RESULT_CACHE.get("fp-two", ver) == "r2"
+    # a missing input file is uncacheable, never a stale serve
+    assert data_version(optimize(Scan(str(warehouse) + ".gone"))) is None
+
+
+# -- progress isolation (same fingerprint, two sessions) ----------------------
+
+def test_progress_snapshot_separates_same_fingerprint_sessions():
+    """Satellite: two live sessions on the SAME plan fingerprint must
+    keep distinct progress entries (per-trace ``key``) with independent
+    ETAs — pre-fix they collapsed into one polluted row."""
+    hold = threading.Barrier(3)
+    entries = {}
+
+    def run(tid, total):
+        with metrics.query("plan:sharedfp12") as qm:
+            if qm is None:
+                hold.wait(timeout=30)
+                return
+            qm.trace_id = tid
+            qm.progress_total(total)
+            qm.progress_step(chunks=total // 2)
+            hold.wait(timeout=30)   # both live while main thread snapshots
+
+    ts = [threading.Thread(target=run, args=(f"trace-{i}", 10 * (i + 1)))
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)
+    for q in metrics.progress_snapshot():
+        if q.get("trace_id", "").startswith("trace-"):
+            entries[q["trace_id"]] = q
+    hold.wait(timeout=30)
+    for t in ts:
+        t.join(timeout=30)
+    if not entries:
+        pytest.skip("SRJT_METRICS disabled")
+    assert set(entries) == {"trace-0", "trace-1"}
+    keys = {q["key"] for q in entries.values()}
+    assert keys == {"trace-0", "trace-1"}   # per-trace, not per-fingerprint
+
+
+# -- session budgets vs the OOM ladder ---------------------------------------
+
+def _exchange_plan(path):
+    return Aggregate(Exchange(Scan(path, chunk_bytes=1 << 16), ["k"]),
+                     ["k"], [("v", "sum")], names=["s"])
+
+
+def _parity(a, b):
+    an = {c: np.asarray(a.column(c).data) for c in a.names}
+    bn = {c: np.asarray(b.column(c).data) for c in b.names}
+    assert an.keys() == bn.keys()
+    for c in an:
+        order_a, order_b = np.argsort(an["k"]), np.argsort(bn["k"])
+        np.testing.assert_array_equal(an[c][order_a], bn[c][order_b])
+
+
+def test_budgeted_session_gets_oom_retry_unbudgeted_degrades(
+        warehouse, serving_env, metrics_isolation):
+    """Satellite bugfix: the degradation ladder consults the session
+    budget BEFORE the global memory picture.  The same injected OOM
+    (first exchange dispatch) degrades an unbudgeted query exactly as
+    before, but a session within its own budget retries the rung once
+    (neighbor pressure) and completes UNdegraded."""
+    metrics_isolation("engine.sched.neighbor_pressure")
+    plan = _exchange_plan(warehouse)
+    base = execute(plan)
+
+    # session A: generous budget, within it -> one same-rung retry eats
+    # the nth=1 injection; no degradation recorded
+    serving_env(SRJT_FAULTS="exchange.dispatch:1:oom",
+                SRJT_SESSION_BUDGET_BYTES=1 << 30)
+    sched = Scheduler()
+    sess = sched.admit(fingerprint="bgt" * 5 + "a", trace_id="t-budget")
+    stats: dict = {}
+    out = execute(plan, stats=stats, session=sess)
+    sess.release()
+    _parity(base, out)
+    assert stats.get("degradations", []) == []
+    from spark_rapids_jni_tpu.utils import tracing
+    assert tracing.counters_snapshot("engine.sched.neighbor_pressure").get(
+        "engine.sched.neighbor_pressure") == 1
+
+    # session B: over budget (earlier chunks already exceeded it) -> the
+    # ladder proceeds exactly like the pre-session behavior
+    serving_env(SRJT_FAULTS="exchange.dispatch:1:oom",
+                SRJT_SESSION_BUDGET_BYTES=1024)
+    sess2 = sched.admit(fingerprint="bgt" * 5 + "b", trace_id="t-over")
+    sess2.charge(1 << 20)     # 1 MiB peak against a 1 KiB budget
+    assert sess2.over_budget()
+    stats2: dict = {}
+    out2 = execute(plan, stats=stats2, session=sess2)
+    sess2.release()
+    _parity(base, out2)
+    assert [d["step"] for d in stats2.get("degradations", [])] == \
+        ["exchange-halved"]
+
+    # unbudgeted control: no session at all -> old ladder, unchanged
+    serving_env(SRJT_FAULTS="exchange.dispatch:1:oom")
+    stats3: dict = {}
+    out3 = execute(plan, stats=stats3)
+    _parity(base, out3)
+    assert [d["step"] for d in stats3.get("degradations", [])] == \
+        ["exchange-halved"]
+
+
+def test_spilled_exchange_budget_clamp(serving_env):
+    """A budgeted session clamps the spilled shuffle's HBM budget to its
+    remaining headroom (floored at 1 MiB)."""
+    serving_env(SRJT_SESSION_BUDGET_BYTES=8 << 20)
+    sched = Scheduler()
+    sess = sched.admit(fingerprint="clamp" * 3 + "x", trace_id="t-clamp")
+    sess.charge(5 << 20)
+    assert sess.budget_remaining() == 3 << 20
+    from spark_rapids_jni_tpu.engine.recovery import RecoveryPolicy
+    rp = RecoveryPolicy(session=sess)
+    assert rp.session_budget_remaining() == 3 << 20
+    sess.release()
+    rp2 = RecoveryPolicy()
+    assert rp2.session_budget_remaining() is None
+
+
+# -- concurrent serving over the bridge ---------------------------------------
+
+@pytest.fixture(scope="module")
+def serving_server(tmp_path_factory):
+    from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
+    sock = str(tmp_path_factory.mktemp("serving") / "tpub.sock")
+    proc = spawn_server(sock, env={"SRJT_RESULT_CACHE": "8",
+                                   "SRJT_MAX_SESSIONS": "4"})
+    yield sock
+    try:
+        c = BridgeClient(sock)
+        c.shutdown_server()
+    except Exception:
+        proc.kill()
+    proc.wait(timeout=30)
+
+
+@pytest.fixture(scope="module")
+def serving_files(tmp_path_factory):
+    root = tmp_path_factory.mktemp("servingio")
+    n = 20_000
+    pq.write_table(pa.table({
+        "k": pa.array((np.arange(n) % 7).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    }), root / "fact.parquet", row_group_size=4096)
+    return root
+
+
+def test_bridge_concurrent_sessions_bit_exact(serving_server, serving_files):
+    """N distinct plans over N concurrent connections: every client gets
+    exactly its own result (no cross-session leakage), and the server's
+    scheduler block says they were admitted as sessions."""
+    from spark_rapids_jni_tpu.bridge import BridgeClient
+    plans = [Filter(Scan(serving_files / "fact.parquet"),
+                    ("<", col("v"), lit(1000 * (i + 1))))
+             for i in range(5)]
+    serial = {}
+    c = BridgeClient(serving_server)
+    for i, p in enumerate(plans):
+        hs = c.execute_plan(p)
+        serial[i] = c.export_table(hs[0])
+        for h in hs:
+            c.release(h)
+    got = {}
+    errs = []
+
+    def run(i):
+        cc = BridgeClient(serving_server)
+        try:
+            hs = cc.execute_plan(plans[i])
+            got[i] = cc.export_table(hs[0])
+            for h in hs:
+                cc.release(h)
+        except Exception as e:  # noqa: BLE001 — surfaced via errs
+            errs.append((i, e))
+        finally:
+            cc.close()
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(len(plans))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errs, errs
+    for i in range(len(plans)):
+        assert got[i].num_rows == serial[i].num_rows == 1000 * (i + 1)
+        for cs, cg in zip(serial[i].columns, got[i].columns):
+            np.testing.assert_array_equal(np.asarray(cs.data),
+                                          np.asarray(cg.data))
+    stats = c.serving_stats()
+    assert stats["scheduler"]["admitted"] >= len(plans)
+    # repeat of plan 0 on unchanged data: served from the result cache
+    before = stats["result_cache"]["hits"]
+    hs = c.execute_plan(plans[0])
+    rc = c.serving_stats()["result_cache"]
+    assert rc["hits"] == before + 1
+    for h in hs:
+        c.release(h)
+    c.close()
+
+
+def test_bridge_shed_carries_trace_and_bundle(tmp_path):
+    """A saturated 1-slot server sheds with the typed error, and the
+    client-side exception carries the trace id + bundle pointer."""
+    from spark_rapids_jni_tpu.bridge import BridgeClient, spawn_server
+    n = 30_000
+    pq.write_table(pa.table({
+        "k": pa.array((np.arange(n) % 5).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    }), tmp_path / "fact.parquet", row_group_size=2048)
+    sock = str(tmp_path / "tpub.sock")
+    proc = spawn_server(sock, env={
+        "SRJT_MAX_SESSIONS": "1", "SRJT_ADMISSION_QUEUE_S": "0.05",
+        "SRJT_BLACKBOX_DIR": str(tmp_path / "bb")})
+    try:
+        plan = Aggregate(Scan(tmp_path / "fact.parquet", chunk_bytes=1 << 14),
+                         ["k"], [("v", "sum")], names=["s"])
+        sheds = []
+        oks = []
+
+        def run(i):
+            c = BridgeClient(sock)
+            try:
+                hs = c.execute_plan(plan if i == 0 else
+                                    Filter(plan, (">", col("s"), lit(i))))
+                oks.append(i)
+                for h in hs:
+                    c.release(h)
+            except AdmissionRejectedError as e:
+                sheds.append(e)
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert oks, "at least one query must run"
+        assert sheds, "a 1-slot server under 6 clients must shed"
+        e = sheds[0]
+        assert e.kind == "resource" and e.retryable is False
+        assert getattr(e, "trace_id", "")          # joinable to telemetry
+        assert getattr(e, "bundle_path", "")       # post-mortem pointer
+    finally:
+        try:
+            c = BridgeClient(sock)
+            c.shutdown_server()
+        except Exception:
+            proc.kill()
+        proc.wait(timeout=30)
