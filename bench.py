@@ -1151,6 +1151,153 @@ print(json.dumps({{
         return None
 
 
+def bench_engine_fused_stage(n_fact=240_000, n_keys=2_000, smoke=False):
+    """Whole-stage fusion across the exchange (SRJT_FUSE_EXCHANGE): the
+    ``partial-agg -> hash Exchange -> final-agg`` sandwich lowered into ONE
+    ``jax.jit(shard_map(...))`` program vs the host-orchestrated exchange
+    path on the same plan (8-device virtual CPU mesh, subprocess like the
+    other dist benches).
+
+    The plan is the dist smoke shape: a chunked scan feeding the grouped
+    aggregate (the host path streams the partial agg chunk-by-chunk and
+    then orchestrates the exchange with two deliberate syncs; the fused
+    path runs the whole stage as one program).  ``SRJT_FUSE_GROUPS`` is
+    sized at 2x the workload's distinct-key count — the documented
+    operator sizing for the static in-program exchange.
+
+    Both paths are compile-warmed, then timed (min of 3).  A scan-only
+    plan (same file, same chunking) is timed the same way and subtracted
+    from both walls: the two paths pay an identical chunked parquet scan,
+    so ``vs_host_exchange`` compares the exchange STAGE (partial agg ->
+    exchange -> final agg) the fusion actually replaces; the raw
+    end-to-end walls and their ratio (``vs_host_e2e``) are reported
+    alongside.  Also reports the host-sync counter deltas of each timed
+    run (the fused run must pay exactly its static ``verify.sync_budget``),
+    the exchange census (static == executed on both paths), and bit-exact
+    result parity.
+    """
+    import subprocess
+    import os
+    import sys as _sys
+    script = f"""
+import json, os, tempfile, time
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import spark_rapids_jni_tpu
+import jax
+root = tempfile.mkdtemp()
+rng = np.random.default_rng(17)
+nf, nk = {n_fact}, {n_keys}
+k = rng.integers(0, nk, nf)
+# quarter-grid floats: partial-then-combine sums are exactly representable,
+# so fused-vs-host parity is bit-exact despite reduction-order differences
+v = (rng.integers(0, 400, nf) * 0.25).astype(np.float64)
+v2 = rng.integers(-100, 100, nf)
+pq.write_table(pa.table({{"k": pa.array(k, pa.int64()),
+                          "v": pa.array(v, pa.float64()),
+                          "v2": pa.array(v2, pa.int64())}}),
+               os.path.join(root, "fact.parquet"), row_group_size=32_000)
+fact = os.path.join(root, "fact.parquet")
+
+from spark_rapids_jni_tpu.engine import (Aggregate, Scan, execute,
+                                         new_stats, optimize)
+from spark_rapids_jni_tpu.engine.verify import plan_exchanges, sync_budget
+from spark_rapids_jni_tpu.utils import tracing
+from spark_rapids_jni_tpu.utils.config import config, refresh
+
+def mkplan():
+    return Aggregate(Scan(fact, chunk_bytes=192_000), ("k",),
+                     (("v", "sum"), ("v2", "sum"), ("v", "count")),
+                     ("total", "t2", "n"))
+
+def syncs():
+    return tracing.counters_snapshot("engine.host_sync") \\
+        .get("engine.host_sync", 0)
+
+def timed(opt):
+    execute(opt, new_stats())                       # warm (compile)
+    best, out, stats, dsync = None, None, None, None
+    for _ in range(3):
+        st = new_stats()
+        s0 = syncs()
+        t0 = time.perf_counter()
+        o = execute(opt, st)
+        jax.block_until_ready([c.data for c in o.columns])
+        dt = time.perf_counter() - t0
+        if best is None or dt < best:
+            best, out, stats, dsync = dt, o, st, syncs() - s0
+    return best, out, stats, dsync
+
+def norm(t):
+    cols = sorted(zip(t.names, (c.to_numpy() for c in t.columns)))
+    order = np.argsort(cols[0][1], kind="stable")
+    return [(n, np.asarray(a)[order].tolist()) for n, a in cols]
+
+# scan-only baseline: both paths pay this identical chunked scan, so the
+# exchange-stage comparison subtracts it from both walls (raw walls are
+# reported too — nothing rides on the subtraction being hidden)
+optS = optimize(Scan(fact, chunk_bytes=192_000), distribute=True)
+tS, _, _, _ = timed(optS)
+
+# host-orchestrated exchange (the pre-fusion distributed path)
+optH = optimize(mkplan(), distribute=True)
+exH = plan_exchanges(optH)
+tH, outH, stH, syH = timed(optH)
+
+# fused whole-stage program; the static group budget sized at 2x the
+# workload's distinct keys (the documented operator sizing — overflow
+# would fall back to the host path, which the dispatch counter catches)
+os.environ["SRJT_FUSE_EXCHANGE"] = "1"
+os.environ["SRJT_FUSE_GROUPS"] = str(2 * nk)
+refresh()
+optF = optimize(mkplan(), distribute=True)
+exF = plan_exchanges(optF)
+budget = sync_budget(optF, cfg=config)
+d0 = tracing.counters_snapshot("engine.fused_stage.dispatches") \\
+    .get("engine.fused_stage.dispatches", 0)
+tF, outF, stF, syF = timed(optF)
+dispatches = tracing.counters_snapshot("engine.fused_stage.dispatches") \\
+    .get("engine.fused_stage.dispatches", 0) - d0
+del os.environ["SRJT_FUSE_EXCHANGE"]
+del os.environ["SRJT_FUSE_GROUPS"]
+refresh()
+
+print(json.dumps({{
+    "host_s": tH, "fused_s": tF, "scan_s": tS,
+    "vs_host_exchange": (tH - tS) / max(tF - tS, 1e-9),
+    "vs_host_e2e": tH / tF if tF else None,
+    "host_syncs": {{"host": syH, "fused": syF,
+                    "fused_budget": sum(e["count"] for e in budget)}},
+    "dispatches": dispatches,
+    "exchanges": {{"host_static": len(exH),
+                   "host_executed": stH["exchanges"],
+                   "fused_static": len(exF),
+                   "fused_executed": stF["exchanges"]}},
+    "results_match": bool(norm(outF) == norm(outH))}}))
+"""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"),
+               JAX_ENABLE_X64="1")
+    env.pop("SRJT_FUSE_EXCHANGE", None)
+    env.pop("SRJT_FUSE_GROUPS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([_sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=900)
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            print(f"engine-fused-stage bench failed (rc={r.returncode}):\n"
+                  f"{r.stderr[-2000:]}", file=_sys.stderr)
+            return None
+        return json.loads(lines[-1])
+    except Exception as e:
+        print(f"engine-fused-stage bench failed: {e!r}", file=_sys.stderr)
+        return None
+
+
 def bench_engine_aqe(n_fact=240_000, n_keys=2_000, smoke=False):
     """Adaptive execution (SRJT_AQE) A/Bs on the virtual 8-device mesh.
 
@@ -1709,6 +1856,37 @@ def smoke():
                           if dres["ratios"]["broadcast_vs_exchange"]
                           else None,
                       }}))
+    # fused whole-stage line: the partial-agg -> exchange -> final-agg
+    # sandwich as ONE shard_map program (SRJT_FUSE_EXCHANGE) vs the
+    # host-orchestrated exchange path — parity must be bit-exact, the
+    # fused run must pay exactly its static sync_budget (and well under
+    # the host path's count; premerge asserts < 5), and the exchange
+    # census must stay static==executed on BOTH paths.  vs_host_exchange
+    # is the report-only fused_stage.* gate key (BENCH_BASELINES.json)
+    fres = bench_engine_fused_stage(n_fact=60_000, n_keys=500, smoke=True)
+    fsync = (fres or {}).get("host_syncs") or {}
+    fok = bool(fres and fres["results_match"]
+               and fres.get("dispatches", 0) >= 1
+               and fsync.get("fused") == fsync.get("fused_budget")
+               and fres["exchanges"]["host_static"]
+               == fres["exchanges"]["host_executed"]
+               and fres["exchanges"]["fused_static"]
+               == fres["exchanges"]["fused_executed"])
+    print(json.dumps({"metric": "fused_stage",
+                      "ok": fok,
+                      "vs_host_exchange": round(fres["vs_host_exchange"], 4)
+                      if fres and fres.get("vs_host_exchange") else None,
+                      "host_syncs": fsync or None,
+                      "dispatches": (fres or {}).get("dispatches"),
+                      "exchanges": (fres or {}).get("exchanges"),
+                      "results_match": (fres or {}).get("results_match"),
+                      "vs_host_e2e": round(fres["vs_host_e2e"], 4)
+                      if fres and fres.get("vs_host_e2e") else None,
+                      "latency_ms": {} if not fres else {
+                          "host_exchange": round(fres["host_s"] * 1e3, 3),
+                          "fused": round(fres["fused_s"] * 1e3, 3),
+                          "scan_baseline": round(fres["scan_s"] * 1e3, 3),
+                      }}))
     # sixth line: adaptive execution — the skewed twin must apply at least
     # one verified skew split (post-split skew gauge under the threshold)
     # and the repeat query must plan run 2 from run 1's measured actuals,
@@ -1777,6 +1955,20 @@ def smoke():
                               round(sres["result_cache_warm_ms"], 3),
                       },
                       "shed": sshed or None}))
+    # roofline line: the fused row-conversion pipeline against the measured
+    # stream ceiling at smoke scale — roofline_frac = achieved / ceiling is
+    # dimensionless, so it tracks formulation regressions (extra passes,
+    # lost fusion) without retuning for machine speed.  Report-only gate
+    # key row_conversion.roofline_frac (BENCH_BASELINES.json); the r5
+    # full-scale value was 0.071
+    rc_dev, rc_cpu, rc_ok, rc_ceiling = bench_row_conversion(n=200_000)
+    print(json.dumps({"metric": "row_conversion",
+                      "ok": bool(rc_ok),
+                      "GBps": round(rc_dev, 3),
+                      "ceiling_GBps": round(rc_ceiling, 2),
+                      "roofline_frac": round(rc_dev / rc_ceiling, 4)
+                      if rc_ceiling else None,
+                      "cpu_GBps": round(rc_cpu, 3)}))
     # profile-store line: every query above (this process AND the dist +
     # aqe subprocesses, via the inherited env) persisted a profile; the
     # store summary must carry the dist exchanges' skew
@@ -1872,8 +2064,8 @@ def smoke():
                       },
                       "ratios": {"on_vs_off": round(bb_ratio, 4)
                                  if bb_ratio else None}}))
-    return 0 if (ok and jok and mok and tok and dok and aok and sok
-                 and pok and vok and bok) else 1
+    return 0 if (ok and jok and mok and tok and dok and fok and aok
+                 and sok and rc_ok and pok and vok and bok) else 1
 
 
 def main():
@@ -1891,6 +2083,7 @@ def main():
     pipe = bench_engine_pipeline()
     ejoin = bench_engine_join()
     edist = bench_engine_dist()
+    efused = bench_engine_fused_stage()
     eaqe = bench_engine_aqe()
     eserv = bench_engine_serving()
 
@@ -2063,6 +2256,33 @@ def main():
                         "the r5 shuffle+SMJ comparator (join stage only); "
                         "co-partitioned scans must plan zero exchanges"}}
                if edist else {}),
+            **({"engine_fused_stage": {
+                "host_exchange_s": round(efused["host_s"], 3),
+                "fused_s": round(efused["fused_s"], 3),
+                "scan_baseline_s": round(efused["scan_s"], 3),
+                "vs_host_exchange": round(
+                    efused["vs_host_exchange"], 3)
+                if efused["vs_host_exchange"] else None,
+                "vs_host_e2e": round(efused["vs_host_e2e"], 3)
+                if efused["vs_host_e2e"] else None,
+                "host_syncs": efused["host_syncs"],
+                "dispatches": efused["dispatches"],
+                "exchanges": efused["exchanges"],
+                "results_match": efused["results_match"],
+                "note": "SRJT_FUSE_EXCHANGE: the partial-agg -> hash "
+                        "Exchange -> final-agg sandwich lowered into ONE "
+                        "jit(shard_map) program (device-side murmur3 "
+                        "placement, bucket scatter, all_to_all, combine) "
+                        "vs the host-orchestrated exchange on the same "
+                        "plan.  The fused run pays exactly its static "
+                        "verify.sync_budget (one boundary sync), the "
+                        "host path pays per-device gathers + a host "
+                        "bucket sort + re-uploads; parity is bit-exact.  "
+                        "vs_host_exchange isolates the exchange stage by "
+                        "subtracting the separately-timed scan-only "
+                        "baseline both paths share; vs_host_e2e is the "
+                        "raw end-to-end wall ratio"}}
+               if efused else {}),
             **({"engine_aqe": {
                 "balanced_s": round(eaqe["balanced_s"], 3),
                 "skewed_s": round(eaqe["skewed_s"], 3),

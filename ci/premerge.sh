@@ -160,13 +160,39 @@ print("aqe: %d skew split(s) applied, skew %.2f -> gauge %.2f "
       "rerun_vs_first %s" % (sk["splits_applied"], sk["pre_skew"],
                              sk["gauge_skew"], sk["threshold"],
                              aqe[0]["rerun_vs_first"]))
+# whole-stage fusion (docs/ENGINE.md): the fused run must pay exactly
+# its static sync budget — and stay far under the host-orchestrated
+# sync count — with bit-exact parity and a matching exchange census.
+# The wall-clock ratio (fused_stage.vs_host_exchange) is report-only in
+# the gate below while it soaks; this block asserts the structure.
+fs = [s for s in snaps if s.get("metric") == "fused_stage"]
+assert fs, "bench.py --smoke emitted no fused_stage line"
+assert fs[0]["ok"], "fused_stage line not ok: %r" % fs[0]
+fsy = fs[0]["host_syncs"]
+assert fsy["fused"] == fsy["fused_budget"], \
+    "fused syncs != static budget: %r" % fsy
+assert fsy["fused"] < 5, \
+    "fused stage paying host-path-order sync counts: %r" % fsy
+assert fs[0]["results_match"], "fused vs host parity failed: %r" % fs[0]
+print("fused_stage: %d sync(s) (== static budget, host path pays %d), "
+      "%d dispatch(es), vs_host_exchange %s, bit-exact"
+      % (fsy["fused"], fsy["host"], fs[0]["dispatches"],
+         fs[0]["vs_host_exchange"]))
+# row-conversion roofline: the smoke line must pass its numpy-oracle
+# wire check; roofline_frac is the report-only gate key
+rc = [s for s in snaps if s.get("metric") == "row_conversion"]
+assert rc, "bench.py --smoke emitted no row_conversion line"
+assert rc[0]["ok"], "row_conversion wire check failed: %r" % rc[0]
+print("row_conversion: %.2f GB/s of %.2f ceiling (roofline_frac %s)"
+      % (rc[0]["GBps"], rc[0]["ceiling_GBps"], rc[0]["roofline_frac"]))
 # multi-tenant serving (docs/SERVING.md): the concurrent pass must be
 # bit-exact per trace vs the serial pass, the forced-low-SLO scenario
 # must shed at least once with the typed admission error carrying
 # trace id + bundle pointer, and the repeat plan must serve from the
 # result cache far under its cold wall.  The wall-clock keys
-# (serving.p99_ms / serving.throughput / serving.shed_count) stay
-# report-only in the gate below; this block asserts the structure.
+# (serving.p99_ms / serving.throughput / serving.shed_count) are
+# ENFORCED in the gate below (promoted r7 after the r6 report-only
+# soak); this block asserts the structure.
 srv = [s for s in snaps if s.get("metric") == "serving"]
 assert srv, "bench.py --smoke emitted no serving line"
 assert srv[0]["ok"], "serving line not ok: %r" % srv[0]
@@ -209,15 +235,17 @@ print("prometheus scrape: %d samples parse as text exposition" % samples)
 '
 
 # bench regression gate: ENFORCED for the smoke-line ratio keys that have
-# soaked since PR 5 (--enforce-keys allowlist — a regression or a silently
+# soaked since PR 5 plus the serving keys promoted r7 after their r6
+# report-only soak (--enforce-keys allowlist — a regression or a silently
 # dropped key among them fails premerge); every other enrolled key,
-# including the PR-8 dist ratios and the new profile-derived keys, stays
-# report-only in the same run.  --profiles folds the query-profile store
-# into the artifact (profile.exchange.skew, profile.chunk_latency.p99).
+# including the PR-8 dist ratios, the profile-derived keys, and the new
+# r7 fused_stage.vs_host_exchange / row_conversion.roofline_frac keys,
+# stays report-only in the same run.  --profiles folds the query-profile
+# store into the artifact (profile.exchange.skew, profile.chunk_latency.p99).
 python ci/bench_gate.py --artifact target/smoke-artifact.json \
     --profiles target/smoke-profiles \
     --enforce \
-    --enforce-keys engine_pipeline_smoke.ratios.fused_vs_interp,engine_join_smoke.ratios.cached_vs_per_chunk
+    --enforce-keys engine_pipeline_smoke.ratios.fused_vs_interp,engine_join_smoke.ratios.cached_vs_per_chunk,serving.p99_ms,serving.throughput,serving.shed_count
 
 # end-to-end trace join (docs/OBSERVABILITY.md): a clean query's
 # client-minted trace id must reach the server's OP_METRICS summary and

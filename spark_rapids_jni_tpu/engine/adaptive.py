@@ -129,6 +129,28 @@ def runtime_entries(root: PlanNode) -> list:
         return [dict(d) for d in dec if d.get("runtime")]
 
 
+def record_fused_dispatch(root: Optional[PlanNode], node: PlanNode,
+                          skew: float, threshold: float,
+                          dispatched: str) -> Optional[dict]:
+    """Ledger the fused-stage escape-hatch probe's routing decision.
+
+    The whole-stage fusion (SRJT_FUSE_EXCHANGE) erases the exchange
+    boundary the skew-split rule fires at, so when AQE is on the executor
+    runs a cheap counts probe first and dispatches either the fused
+    program or the host-orchestrated path (where ``try_skew_split`` still
+    sees the exchange).  ``dispatched`` is ``"fused"`` or ``"host"``.
+    """
+    if root is None:
+        return None
+    return record(root, {
+        "kind": "fused_stage",
+        "path": _path(root, node),
+        "measured_skew": round(float(skew), 6),
+        "threshold": float(threshold),
+        "dispatch": dispatched,
+    })
+
+
 # -- eligibility stamping (called at the end of optimize()) -----------------
 
 def stamp_eligibility(plan: PlanNode) -> None:

@@ -366,10 +366,128 @@ def _exec_aggregate(node: Aggregate, memo: dict, stats: dict,
             ctx.recovery.degrade("stream-interpreted", e, stats)
             return _exec_streamed(node, scan, memo, stats, ctx,
                                   force_interp=True)
+    from ..utils.config import config
+    if config.fuse_exchange:
+        out = _try_fused_stage(node, memo, stats, ctx)
+        if out is not None:
+            return out
     seg = ctx.segment_for(node)
     if seg is not None:
         return _exec_segment(seg, memo, stats, ctx, node)
     return _groupby(_exec(node.child, memo, stats, ctx), node)
+
+
+def _try_fused_stage(node: Aggregate, memo: dict, stats: dict,
+                     ctx: _ExecCtx) -> Optional[Table]:
+    """Whole-stage fusion (engine/segment.py ``FusedStage``): lower the
+    ``partial-agg -> hash Exchange -> final-agg`` sandwich rooted at
+    ``node`` into ONE pjit/shard_map program — partial groupby, bucket
+    scatter, all_to_all, and combine groupby with zero host round-trips
+    between the three plan nodes.  Returns the stage result, or None to
+    fall through to the host-orchestrated path (not a sandwich, shared
+    interior nodes, ineligible schema, the AQE probe routed to the
+    adaptive path, or capacity overflow — runtime re-plans, never
+    errors)."""
+    import jax
+
+    from ..utils.config import config
+    from . import segment as sg
+
+    # prefer the optimizer's stamped hint (planner-blessed detection);
+    # hand-built plans that never went through optimize() re-derive it
+    stage = getattr(node, "_fuse_stage", None) or sg.fused_sandwich(node)
+    if stage is None:
+        return None
+    ex, partial = stage.exchange, stage.partial
+    npar = ctx.nparents if ctx.fuse else sg.parent_counts(ctx.root)
+    if npar.get(id(ex), 1) != 1 or npar.get(id(partial), 1) != 1:
+        return None  # shared interior nodes must materialize for others
+    ndev = len(jax.devices())
+    if ndev <= 1:
+        return None  # placement over one device is the identity
+    inp = _exec(partial.child, memo, stats, ctx)
+    if not sg.fused_runtime_eligible(stage, inp):
+        return None
+    from ..parallel.mesh import ROW_AXIS, make_mesh, shard_table
+    mesh = make_mesh(ndev)
+
+    prepped = None
+    if config.aqe and getattr(ex, "_aqe_split", False):
+        # AQE escape hatch: the skew-split rule fires AT the exchange
+        # boundary this fusion erases, so a cheap counts probe picks
+        # which program to dispatch — input-row skew at or under the
+        # split threshold dispatches the fused program; anything hotter
+        # routes to the host-orchestrated path where try_skew_split's
+        # full machinery (deal, verify, ledger, pre-combine) still
+        # fires.  Row skew upper-bounds partial-output group skew, so
+        # the probe only ever errs TOWARD the adaptive path — it cannot
+        # strand a hot key inside the fused program.
+        from ..parallel import shuffle as sh
+        from . import adaptive
+        probed, n = sg.fused_pad(inp.select(stage.sel_names()), ndev)
+        probed_sharded = shard_table(probed, mesh)
+        counts = sh.partition_counts(probed_sharded, mesh,
+                                     list(stage.combine.keys),
+                                     n_valid_rows=n)
+        prepped = (probed, n, probed_sharded)  # reused by the dispatch
+        metrics.host_sync(key=id(ex), label="exchange-counts-sizing")
+        probe_skew = sh.device_load_stats(counts.sum(axis=0))["skew"]
+        fused = probe_skew <= float(config.aqe_skew)
+        adaptive.record_fused_dispatch(ctx.root, ex, probe_skew,
+                                       float(config.aqe_skew),
+                                       "fused" if fused else "host")
+        if not fused:
+            metrics.count("engine.fused_stage.aqe_fallbacks")
+            return None
+
+    with op_scope("engine.fused_stage"):
+        res = sg.run_fused_stage(stage, inp, mesh, ROW_AXIS,
+                                 prepped=prepped)
+    if res is None:
+        return None  # static capacity overflowed: the host path re-plans
+    out, info = res
+    rows_mat = info["rows_matrix"]
+    # the lowered Exchange still counts: the executed-exchange census
+    # (stats vs verify.plan_exchanges) and the flight recorder see the
+    # same events whether the exchange ran in-program or host-side
+    stats["exchanges"] += 1
+    stats["nodes"] += 2  # the bypassed Exchange + partial Aggregate
+    from ..utils import blackbox
+    blackbox.record("exchange", kind=ex.kind,
+                    rows=int(rows_mat.sum()), in_program=True)
+    wire = int(info["wire_bytes"])
+    metrics.count("engine.exchange.shuffles")
+    metrics.count("engine.exchange.wire_bytes", wire)
+    qm = metrics.current()
+    if qm is not None:
+        qm.node_add(id(ex), node_label(ex), chunks=1, wire_bytes=wire)
+    if metrics.enabled():
+        from ..parallel import shuffle as sh
+        # per-device attribution from the DEVICE-side counts output that
+        # rode the result fetch — zero additional host syncs, and the
+        # wire matrix sums to the engine.exchange.wire_bytes increment
+        # above by construction (every padded slot crosses the wire)
+        st = sh.device_load_stats(rows_mat.sum(axis=0))
+        metrics.gauge_set("engine.exchange.skew", st["skew"])
+        metrics.gauge_set("engine.exchange.straggler_share",
+                          st["straggler_share"])
+        metrics.gauge_set("engine.exchange.max_dev_rows",
+                          st["max_dev_rows"])
+        for d, r in enumerate(st["dev_rows"]):
+            metrics.gauge_set(f"engine.exchange.dev{d}.rows", float(r))
+            metrics.observe("engine.exchange.dev_rows", r)
+        if qm is not None:
+            qm.node_set(id(ex), node_label(ex),
+                        skew=st["skew"],
+                        straggler_share=st["straggler_share"],
+                        max_dev_rows=st["max_dev_rows"],
+                        cap_rows=info["ndev"] * info["capacity"],
+                        dev_rows=st["dev_rows"],
+                        rows_matrix=rows_mat.tolist(),
+                        wire_matrix=info["wire_matrix"].tolist(),
+                        in_program=True)
+            qm.node_set(id(node), node_label(node), in_program=True)
+    return out
 
 
 def _exec_sort(node: Sort, memo: dict, stats: dict, ctx: _ExecCtx) -> Table:
@@ -515,8 +633,13 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
                                  shard_table)
 
     ndev = len(jax.devices())
-    if ndev <= 1 or table.num_rows == 0:
+    if ndev <= 1:
         return table  # placement over one device is the identity
+    # NO empty-input early-out: a zero-row exchange runs the same
+    # counts + payload passes over zero-filled shards (every helper
+    # below has a sound n == 0 branch), so the runtime host-sync count
+    # equals verify.sync_budget's static charge EXACTLY — the PR 8
+    # review's empty-input upper-bound discrepancy, closed
 
     plan = None
     keys = list(node.keys)
@@ -536,7 +659,7 @@ def _hash_exchange(node: Exchange, table: Table, ctx: _ExecCtx,
 
     mesh = make_mesh(ndev)
     rows = table.num_rows
-    nchunks = -(-rows // chunk_rows)
+    nchunks = max(1, -(-rows // chunk_rows))  # 0 rows still run one pass
     row_spec = NamedSharding(mesh, PartitionSpec(ROW_AXIS))
     layout = fixed_width_layout(table.dtypes())
 

@@ -165,6 +165,11 @@ def _annotate(span: Optional[dict], ceiling: Optional[float] = None,
             bits.append(f"exch_GB/s={gbps:.3f}")
             if ceiling:
                 bits.append(f"exch_roofline_frac={gbps / ceiling:.6f}")
+    if span.get("in_program"):
+        # the node ran INSIDE a fused whole-stage program (whole-stage
+        # fusion, SRJT_FUSE_EXCHANGE): its collectives paid no host
+        # round-trip of their own
+        bits.append("in_program=yes")
     if span.get("skew") is not None:
         # per-device exchange attribution (executor._hash_exchange /
         # _broadcast_exchange): destination-load balance + breakdown

@@ -525,6 +525,14 @@ VARIANTS = (
     {"name": "dist-aqe", "fuse": True, "distribute": True,
      "broadcast_rows": 0, "aqe": True, "aqe_broadcast_rows": 1_000_000,
      "aqe_skew": 1.0},
+    # whole-stage fusion: the partial/final aggregate sandwich lowers to
+    # ONE jit(shard_map) program (SRJT_FUSE_EXCHANGE).  Bit-exact parity
+    # vs every other variant asserts the in-program exchange is
+    # content-exact; the exchange-census check asserts the lowered
+    # exchange still ticks stats["exchanges"]; the sync-whitelist check
+    # covers the fused-stage budget entries
+    {"name": "dist-fused", "fuse": True, "distribute": True,
+     "broadcast_rows": 0, "fuse_exchange": True},
 )
 
 #: extra variants the nightly sweep adds on top of VARIANTS
@@ -533,6 +541,13 @@ FULL_VARIANTS = VARIANTS + (
      "broadcast_rows": 0},
     {"name": "interp-notopk", "fuse": False, "distribute": False,
      "topk": False},
+    # fusion composed with the AQE adversary: the counts probe routes hot
+    # stages to the host path where the skew split still fires, cold ones
+    # into the fused program — parity and the adaptive-ledger invariant
+    # hold either way
+    {"name": "dist-fused-aqe", "fuse": True, "distribute": True,
+     "broadcast_rows": 0, "fuse_exchange": True, "aqe": True,
+     "aqe_broadcast_rows": 1_000_000, "aqe_skew": 1.0},
 )
 
 

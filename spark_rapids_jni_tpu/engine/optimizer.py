@@ -695,4 +695,15 @@ def optimize(plan: PlanNode,
         # structural pass would rebuild the nodes and drop them
         from . import adaptive
         adaptive.stamp_eligibility(plan)
+    if config.fuse_exchange:
+        # whole-stage fusion hint: precompute the partial/final sandwich
+        # detection (same structural test the static census uses) so the
+        # executor dispatches the planner-blessed FusedStage instead of
+        # re-deriving it per execution.  A plain-attribute stamp like the
+        # AQE ones above: fingerprints stay byte-identical.
+        from . import segment as sg
+        for n in topo_nodes(plan):
+            st = sg.fused_sandwich(n)
+            if st is not None:
+                object.__setattr__(n, "_fuse_stage", st)
     return plan

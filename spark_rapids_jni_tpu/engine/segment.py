@@ -622,3 +622,496 @@ def combine_partials(partials: list, compiled: CompiledSegment) -> Table:
     kval = tuple(spec[3] for spec in out_keys)
     return _compact_padded(compiled.key_dtypes, kdat, kval, out_aggs,
                            ngroups, list(agg.keys) + list(agg.names))
+
+
+# -- whole-stage fusion: the exchange inside the program --------------------
+#
+# The segments above stop at pipeline breakers, and Exchange is the breaker
+# that costs the most: the host orchestrates a two-phase shuffle (counts
+# sync + compaction sync) BETWEEN the partial and final aggregate programs
+# of a distributed group-by.  Flare's whole-stage result (PAPERS.md) says
+# the stage should be ONE native program, so ``FusedStage`` lowers the
+# optimizer's ``partial-agg -> hash Exchange -> final-agg`` sandwich into a
+# single jit(shard_map(...)) callable: per-shard partial groupby, murmur3
+# bucket scatter, one dense all_to_all, per-shard combine groupby — zero
+# host round-trips between the three plan nodes.  Capacity sizing moves
+# device-side (a static function of the shard shape, overflow-checked), so
+# the whole stage pays exactly ONE deliberate host sync: the boundary
+# compaction.  Flag-gated by SRJT_FUSE_EXCHANGE; the host-orchestrated
+# path remains the fallback (runtime-ineligible schema, AQE probe routing,
+# capacity overflow) with bit-exact row-multiset parity.
+
+#: partial-side ops a fused stage supports: must both run on groupby's
+#: fast traced path (ops.aggregate._FAST_OPS) and decompose into a merge
+#: op (executor._STREAM_COMBINE keys) — the optimizer's sandwich
+#: construction guarantees this; the detector re-checks for hand-built
+#: plans
+_FUSED_PARTIAL_OPS = frozenset({"sum", "count", "count_all", "min", "max"})
+#: merge-side ops (the _STREAM_COMBINE value set)
+_FUSED_COMBINE_OPS = frozenset({"sum", "min", "max"})
+
+
+class FusedStage:
+    """One distributed stage — ``Aggregate(final) -> Exchange(hash) ->
+    Aggregate(partial)`` — compiled as a single pjit program."""
+
+    __slots__ = ("combine", "exchange", "partial", "_fp")
+
+    def __init__(self, combine: Aggregate, exchange, partial: Aggregate):
+        self.combine = combine
+        self.exchange = exchange
+        self.partial = partial
+        self._fp: Optional[str] = None
+
+    def sel_names(self) -> list:
+        """Input columns the stage consumes: group keys then agg inputs."""
+        out = list(self.combine.keys)
+        for c, _ in self.partial.aggs:
+            if c is not None and c not in out:
+                out.append(c)
+        return out
+
+    def fingerprint(self) -> str:
+        if self._fp is None:
+            sig = ("fused-stage", tuple(self.combine.keys),
+                   tuple(self.partial.aggs), tuple(self.partial.names),
+                   tuple(self.combine.aggs), tuple(self.combine.names),
+                   tuple(self.exchange.keys))
+            self._fp = hashlib.sha256(repr(sig).encode()).hexdigest()
+        return self._fp
+
+
+def fused_sandwich(node) -> Optional[FusedStage]:
+    """Detect the partial/final sandwich rooted at ``node`` (the same
+    structural test as ``verify.decision_census``) plus op eligibility.
+    Returns None when ``node`` cannot head a fused stage."""
+    from .plan import Exchange
+    if not isinstance(node, Aggregate):
+        return None
+    ex = node.child
+    if not (isinstance(ex, Exchange) and ex.kind == "hash"):
+        return None
+    p = ex.child
+    if not (isinstance(p, Aggregate) and p.keys
+            and tuple(p.keys) == tuple(node.keys)
+            and tuple(p.names) == tuple(node.names)):
+        return None
+    if not set(ex.keys) <= set(node.keys):
+        return None  # the exchange must co-locate whole groups
+    if len(node.aggs) != len(p.aggs):
+        return None
+    if any(op not in _FUSED_PARTIAL_OPS for _, op in p.aggs):
+        return None
+    if any(op not in _FUSED_COMBINE_OPS for _, op in node.aggs):
+        return None
+    return FusedStage(node, ex, p)
+
+
+def _fused_col_ok(dt) -> bool:
+    """Dtype gate shared by the static (verify) and runtime checks: stage
+    columns cross the exchange as dense u32 word planes, so they must be
+    1-D fixed-width (no strings/nested; DECIMAL128's (n, 2) limb buffer
+    breaks the single-plane-per-word decomposition)."""
+    return (dt.is_fixed_width and not dt.is_string and not dt.is_nested
+            and not dt.is_decimal)
+
+
+def fused_static_eligible(stage: FusedStage, schema=None) -> bool:
+    """Schema-level eligibility from a name -> DType mapping (the
+    verifier's resolved view).  Unknown columns assume eligible — the
+    runtime check over the actual table has the final veto, and an
+    ineligible stage falls back to the host-orchestrated path."""
+    if schema is None:
+        return True
+    for nm in stage.sel_names():
+        dt = schema.get(nm)
+        if dt is not None and not _fused_col_ok(dt):
+            return False
+    return True
+
+
+def fused_runtime_eligible(stage: FusedStage, table: Table) -> bool:
+    """The actual input schema's veto (mirrors ``runtime_eligible``)."""
+    try:
+        for nm in stage.sel_names():
+            c = table.column(nm)
+            if not _fused_col_ok(c.dtype) or c.data is None \
+                    or c.data.ndim != 1:
+                return False
+    except (KeyError, ValueError):
+        return False
+    return True
+
+
+def fused_prefix(n_local: int) -> int:
+    """Static per-shard live-group budget of the fused stage.
+
+    The partial groupby packs its live groups to the FRONT of the padded
+    output, so everything downstream of it — placement hashing, plane
+    build, the pack sort, the all_to_all block, and the final combine —
+    only needs to see a static PREFIX sized for the groups a shard can
+    plausibly hold, not the shard's full row count.  Sizing that prefix
+    from rows (the obvious static bound) makes the combine sort
+    ``ndev * capacity`` mostly-dead slots and triples the stage's wall
+    time on a 30k-row shard with 2k live groups, so the budget comes from
+    ``SRJT_FUSE_GROUPS`` instead (bucketed for compile-cache stability,
+    clamped by the row bound).  A shard that aggregates MORE live groups
+    than the budget trips the same device-side psum'd overflow counter as
+    a full exchange bucket, and the executor re-plans on the
+    host-orchestrated path — a runtime fallback, never an error.
+    """
+    from ..parallel.shuffle import cap_bucket
+    if n_local <= 0:
+        return 1
+    return min(n_local, cap_bucket(max(1, int(config.fuse_groups))))
+
+
+def fused_capacity(prefix: int, ndev: int) -> int:
+    """Static per-(src, dest) slot capacity of the in-program exchange.
+
+    The host path sizes capacity from a counts pass — a deliberate host
+    sync this fusion exists to delete — so capacity must be a static
+    function of the compiled shape.  ``prefix`` (``fused_prefix``) bounds
+    a shard's send volume and murmur3 spreads groups near-uniformly over
+    destinations, so 2x the uniform share covers realistic imbalance; the
+    psum'd overflow counter (fetched with the one boundary sync) detects
+    the adversarial remainder and the executor falls back to the
+    host-orchestrated exchange when it fires — a runtime re-plan, never
+    an error.
+    """
+    from ..parallel.shuffle import cap_bucket
+    return min(cap_bucket(2 * (-(-prefix // ndev))), cap_bucket(prefix))
+
+
+def _build_fused_fn(stage: FusedStage, compiled: "CompiledFusedStage"):
+    """The per-shard body of the fused stage, traced ONCE under
+    ``jax.jit(shard_map(...))``: partial groupby -> murmur3 dest ->
+    bucket pack -> all_to_all -> combine groupby, all device-resident.
+    Registered in tools/srjt_lint.py TRACED_FUNCS and linted by
+    ``verify.lint_fused_stage`` (no callbacks, no host concretization
+    inside the collectives)."""
+    from ..ops.aggregate import groupby_padded
+    from ..ops.row_conversion import (_build_planes, _from_planes,
+                                      fixed_width_layout)
+    from ..parallel.shuffle import exchange_planes, partition_ids_specs
+
+    partial, combine = stage.partial, stage.combine
+    keys = list(combine.keys)
+    nk = len(keys)
+    sel = stage.sel_names()
+    ndev, axis = compiled.ndev, compiled.axis
+    capacity = compiled.capacity
+    prefix = compiled.prefix
+
+    def fn(datas, masks, n_valid):
+        compiled.traces += 1  # trace-time side effect: no-recompile proof
+        table = Table([Column(dt, data=d, validity=m)
+                       for dt, d, m in zip(compiled.in_dtypes, datas,
+                                           masks)], list(sel))
+        n_local = datas[0].shape[0]
+        shard = jax.lax.axis_index(axis).astype(jnp.int64)
+        gid = shard * jnp.int64(n_local) + jnp.arange(n_local,
+                                                      dtype=jnp.int64)
+        live = gid < n_valid
+
+        # 1) shard-local partial aggregate (live groups pack to the front)
+        out_keys, out_aggs, ng1 = groupby_padded(
+            table, keys, [(c, op) for c, op in partial.aggs],
+            row_mask=live)
+        # static prefix slice (fused_prefix): slots past the compiled
+        # group budget can only hold dead padding — unless this shard
+        # aggregated more live groups than the budget, which feeds the
+        # same psum'd overflow defense as a full exchange bucket below.
+        # Everything downstream is sized by `prefix`, not raw shard rows.
+        pre_overflow = jnp.maximum(ng1 - jnp.int32(prefix), 0)
+        if prefix < n_local:
+            out_keys = [(s[0], s[1], s[2][:prefix],
+                         None if s[3] is None else s[3][:prefix])
+                        for s in out_keys]
+            out_aggs = [Column(c.dtype, data=c.data[:prefix],
+                               validity=None if c.validity is None
+                               else c.validity[:prefix])
+                        for c in out_aggs]
+        glive = jnp.arange(prefix, dtype=jnp.int32) < ng1
+
+        # 2) Spark-exact placement of each live group — the same
+        #    partition_ids_specs the host exchange uses over fixed specs
+        kcols = [Column(s[1], data=s[2], validity=s[3]) for s in out_keys]
+        specs = tuple(("fixed", i, kcols[i].dtype) for i in range(nk))
+        dest = partition_ids_specs(kcols, specs, ndev)
+
+        # 3) partial rows -> word planes -> one dense all_to_all block
+        layout = fixed_width_layout(
+            [c.dtype for c in kcols] + [c.dtype for c in out_aggs])
+        compiled.layout = layout  # static at trace: host wire attribution
+        compiled.agg_dtypes = tuple(c.dtype for c in out_aggs)
+        planes = _build_planes(
+            layout,
+            [c.data for c in kcols] + [c.data for c in out_aggs],
+            [c.validity for c in kcols] + [c.validity for c in out_aggs])
+        planes_in, rok, overflow = exchange_planes(
+            planes, dest, glive, ndev, capacity, axis)
+
+        # 4) received planes -> columns -> shard-local final combine
+        datas_in, masks_in = _from_planes(layout, list(planes_in))
+        recv = Table([Column(dt, data=d, validity=m)
+                      for dt, d, m in zip(layout.schema, datas_in,
+                                          masks_in)],
+                     keys + list(partial.names))
+        out_keys2, out_aggs2, ng2 = groupby_padded(
+            recv, keys, [(c, op) for c, op in combine.aggs], row_mask=rok)
+
+        # 5) stage outputs: padded combine results, plus the per-shard
+        #    send-counts row (the attribution matrix rides the result
+        #    fetch — no extra sync) and the psum'd overflow defense
+        sent = jnp.zeros((ndev,), jnp.int32).at[
+            jnp.where(glive, dest, jnp.int32(ndev))].add(1, mode="drop")
+        kdat = tuple(s[2] for s in out_keys2)
+        kval = tuple(s[3] for s in out_keys2)
+        adat = tuple(c.data for c in out_aggs2)
+        avalid = tuple(jnp.ones(c.data.shape[0], jnp.bool_)
+                       if c.validity is None else c.validity
+                       for c in out_aggs2)
+        return (kdat, kval, adat, avalid, ng2[None], sent[None],
+                jax.lax.psum(overflow + pre_overflow, axis))
+
+    return fn
+
+
+class CompiledFusedStage:
+    """One (stage, input shape-class, mesh) entry: the whole distributed
+    stage as one ``jax.jit(shard_map(...))`` callable, plus the trace
+    counter that proves re-dispatches replay one executable."""
+
+    __slots__ = ("key", "stage", "mesh", "axis", "ndev", "prefix",
+                 "capacity", "in_dtypes", "key_dtypes", "layout",
+                 "agg_dtypes", "traces", "calls", "jfn")
+
+    def __init__(self, key: tuple, stage: FusedStage, mesh, axis: str,
+                 in_dtypes: tuple, key_dtypes: tuple, n_local: int):
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import axis_size
+        from ..parallel.shuffle import shard_map
+        self.key = key
+        self.stage = stage
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = axis_size(mesh, axis)
+        self.prefix = fused_prefix(n_local)
+        self.capacity = fused_capacity(self.prefix, self.ndev)
+        self.in_dtypes = in_dtypes
+        self.key_dtypes = key_dtypes
+        self.layout = None      # captured at trace time (_build_fused_fn)
+        self.agg_dtypes = None  # likewise: groupby's widened output dtypes
+        self.traces = 0
+        self.calls = 0
+        spec = P(axis)
+        self.jfn = jax.jit(shard_map(
+            _build_fused_fn(stage, self), mesh=mesh,
+            in_specs=(spec, spec, P()),
+            out_specs=(spec, spec, spec, spec, spec, spec, P()),
+            check_vma=False))
+
+    def __call__(self, datas, masks, n_valid):
+        self.calls += 1
+        if not metrics.enabled() and not timeline.enabled():
+            return self.jfn(datas, masks, n_valid)
+        tr0 = self.traces
+        t0 = time.perf_counter()
+        out = self.jfn(datas, masks, n_valid)
+        dt = time.perf_counter() - t0
+        kind = "compile" if self.traces > tr0 else "replay"
+        timeline.complete(f"engine.fused_stage.{kind}", t0, dt)
+        if metrics.enabled():
+            metrics.count(f"engine.fused_stage.{kind}")
+            if kind == "compile":
+                metrics.observe("engine.fused_stage.trace_s", dt)
+        return out
+
+
+class FusedStageCache:
+    """LRU: (stage fingerprint, input shape-class, ndev, axis) ->
+    CompiledFusedStage.  Counters flow through ``utils.tracing`` as
+    ``engine.fused_stage_cache.{hit,miss,eviction}``; sized by the same
+    SRJT_SEGMENT_CACHE knob as the segment cache (both hold compiled
+    executables keyed by shape-class)."""
+
+    def __init__(self, maxsize: Optional[int] = None):
+        self._maxsize = None if maxsize is None else int(maxsize)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, CompiledFusedStage]" = \
+            OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def maxsize(self) -> int:
+        return self._maxsize if self._maxsize is not None \
+            else config.segment_cache
+
+    def get(self, stage: FusedStage, padded: Table, mesh,
+            axis: str) -> CompiledFusedStage:
+        from ..parallel.mesh import axis_size
+        ndev = axis_size(mesh, axis)
+        # fused_prefix in the key: an SRJT_FUSE_GROUPS change must compile
+        # a fresh program, not replay one sized for the old budget
+        key = (stage.fingerprint(), shape_class(padded), ndev, axis,
+               fused_prefix(padded.num_rows // ndev))
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                metrics.count("engine.fused_stage_cache.hit")
+                return hit
+        in_dtypes = tuple(c.dtype for c in padded.columns)
+        key_dtypes = tuple(padded.column(k).dtype
+                           for k in stage.combine.keys)
+        compiled = CompiledFusedStage(key, stage, mesh, axis, in_dtypes,
+                                      key_dtypes,
+                                      padded.num_rows // ndev)
+        with self._lock:
+            racer = self._entries.get(key)
+            if racer is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                metrics.count("engine.fused_stage_cache.hit")
+                return racer
+            self.misses += 1
+            metrics.count("engine.fused_stage_cache.miss")
+            self._entries[key] = compiled
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                metrics.count("engine.fused_stage_cache.eviction")
+            return compiled
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "size": len(self._entries), "maxsize": self.maxsize}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: process-wide compiled fused-stage cache
+FUSED_STAGE_CACHE = FusedStageCache()
+
+
+def fused_pad(t: Table, ndev: int):
+    """``pad_to_multiple`` with the degenerate-input synthesis: an empty
+    table still runs the SAME one-sync program over ndev synthetic dead
+    rows (groupby's fast path needs >= 1 row per shard; n_valid=0 masks
+    every one of them out) — this is what makes ``verify.sync_budget``
+    EXACT for the fused path where the host exchange used to early-out
+    on empty inputs (PR 8 review).  Returns (padded Table, n_valid)."""
+    from ..parallel.mesh import pad_to_multiple
+    if t.num_rows == 0:
+        return Table([Column(c.dtype,
+                             data=jnp.zeros((ndev,),
+                                            c.dtype.device_storage),
+                             validity=jnp.zeros((ndev,), jnp.bool_))
+                      for c in t.columns], list(t.names)), 0
+    return pad_to_multiple(t, ndev)
+
+
+def run_fused_stage(stage: FusedStage, table: Table, mesh,
+                    axis: str, prepped=None):
+    """Execute the whole distributed stage over ``table`` (the partial
+    aggregate's INPUT).  Returns ``(result Table, info dict)`` on
+    success or ``None`` when the static capacity overflowed (the caller
+    falls back to the host-orchestrated path — a runtime re-plan).
+
+    ``prepped`` is an optional ``(padded, nrows, sharded)`` triple from
+    a caller that already padded and device-placed the stage input (the
+    AQE counts probe does) — reusing it skips a second pad + per-column
+    device_put round.
+
+    Exactly ONE deliberate host sync for the entire stage: the boundary
+    compaction fetch (per-shard group counts, overflow, the send-counts
+    attribution matrix, and the output buffers all ride it) — vs the
+    host-orchestrated path's four (two groupby compactions + the
+    exchange's counts-sizing and compaction syncs).
+    """
+    from ..ops.order import SortKey, encode_keys
+    from ..parallel.mesh import axis_size, shard_table
+
+    ndev = axis_size(mesh, axis)
+    if prepped is None:
+        padded, nrows = fused_pad(table.select(stage.sel_names()), ndev)
+        sharded = shard_table(padded, mesh, axis)
+    else:
+        padded, nrows, sharded = prepped
+    compiled = FUSED_STAGE_CACHE.get(stage, padded, mesh, axis)
+    datas = tuple(c.data for c in sharded.columns)
+    masks = tuple(c.validity for c in sharded.columns)
+    with timeline.span("engine.fused_stage.dispatch",
+                       {"capacity": int(compiled.capacity),
+                        "rows": int(table.num_rows)}):
+        kdat, kval, adat, avalid, ngv, sent, overflow = compiled(
+            datas, masks, jnp.int64(nrows))
+
+    # the ONE deliberate host sync of the whole stage: everything below
+    # reads buffers this fetch already forced to the host.  One batched
+    # device_get (not per-plane np.asarray) so the transfers overlap
+    # instead of serializing eleven blocking copies.
+    metrics.host_sync(label="groupby-compaction")
+    kdat, kval, adat, avalid, ngv, sent, overflow = jax.device_get(
+        (kdat, kval, adat, avalid, ngv, sent, overflow))
+    if int(overflow):
+        metrics.count("engine.fused_stage.overflow_fallbacks")
+        return None
+    ng = np.asarray(ngv, dtype=np.int64)
+    counts = np.asarray(sent, dtype=np.int64)
+    ndv, cap = compiled.ndev, compiled.capacity
+    stride = ndv * cap
+
+    def compact(arr):
+        a = np.asarray(arr)
+        return np.concatenate([a[s * stride: s * stride + int(ng[s])]
+                               for s in range(ndv)])
+
+    kds = [compact(d) for d in kdat]
+    kvs = [compact(v) for v in kval]
+    ads = [compact(d) for d in adat]
+    avs = [compact(v) for v in avalid]
+
+    # canonical output order: ascending encoded key words — the order one
+    # GLOBAL groupby (the host path) produces.  Hash placement makes the
+    # per-shard key sets disjoint, so a stable global lexsort of the
+    # per-shard sorted runs restores positional parity with the unfused
+    # result, not just multiset parity.
+    key_cols = [Column(dt, data=jnp.asarray(kd), validity=jnp.asarray(kv))
+                for dt, kd, kv in zip(compiled.key_dtypes, kds, kvs)]
+    words = [np.asarray(w)
+             for w in encode_keys([SortKey(c) for c in key_cols])]
+    order = np.lexsort(tuple(reversed(words))) if words else \
+        np.arange(kds[0].shape[0] if kds else 0)
+
+    cols = []
+    for dt, kd, kv in zip(compiled.key_dtypes, kds, kvs):
+        v = kv[order]
+        cols.append(Column(dt, data=jnp.asarray(kd[order]),
+                           validity=None if v.all() else jnp.asarray(v)))
+    for dt, ad, av in zip(compiled.agg_dtypes, ads, avs):
+        v = av[order]
+        cols.append(Column(dt, data=jnp.asarray(ad[order]),
+                           validity=None if v.all() else jnp.asarray(v)))
+    out = Table(cols, list(stage.combine.keys) + list(stage.combine.names))
+    metrics.count("engine.fused_stage.dispatches")
+    row_size = compiled.layout.row_size
+    info = {"capacity": cap, "ndev": ndv, "row_size": row_size,
+            "wire_bytes": ndv * ndv * cap * row_size,
+            "rows_matrix": counts,  # [src, dest], device-derived
+            "wire_matrix": np.full((ndv, ndv), cap * row_size, np.int64),
+            "in_rows": int(table.num_rows)}
+    return out, info

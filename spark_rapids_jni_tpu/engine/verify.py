@@ -826,17 +826,32 @@ def check_partitioning(plan: PlanNode) -> None:
                     f"split across devices")
 
 
-def plan_segments(plan: PlanNode, cfg=None) -> list:
+def plan_segments(plan: PlanNode, cfg=None, ndev: Optional[int] = None,
+                  resolver: Optional[SchemaResolver] = None) -> list:
     """The fused segments the executor would form for ``plan`` — the same
     selection logic as ``_exec``/``_exec_streamed``, run statically: each
     entry is ``{"kind": "map"|"agg"|"stream-agg", "segment", "node",
     "path"}``.  Interior chain nodes are consumed by their segment, so the
-    walk (parents before children) never double-roots a chain."""
+    walk (parents before children) never double-roots a chain.
+
+    With ``cfg.fuse_exchange`` on a >1-device mesh, a partial/final
+    aggregate sandwich lowers to a single ``{"kind": "fused-stage",
+    "stage": FusedStage, ...}`` entry (the whole distributed stage is ONE
+    pjit program — the combine, exchange, and partial nodes are all
+    consumed by it; the walk continues below the partial's child, exactly
+    where the runtime roots its lower segments).  ``resolver`` feeds the
+    static dtype eligibility check; ``ndev`` defaults to the runtime
+    device count."""
     from ..utils.config import config as _config
     from . import segment as sg
     from .executor import _stream_scan_of
     cfg = cfg or _config
-    if not cfg.fuse:
+    fuse_x = getattr(cfg, "fuse_exchange", False)
+    if fuse_x and ndev is None:
+        import jax
+        ndev = len(jax.devices())
+    fuse_x = fuse_x and (ndev or 0) > 1
+    if not cfg.fuse and not fuse_x:
         return []
     nparents = sg.parent_counts(plan)
     paths = node_paths(plan)
@@ -844,6 +859,21 @@ def plan_segments(plan: PlanNode, cfg=None) -> list:
     consumed: set = set()
     for node in reversed(topo_nodes(plan)):
         if id(node) in consumed:
+            continue
+        if fuse_x and isinstance(node, Aggregate):
+            stage = sg.fused_sandwich(node)
+            if stage is not None \
+                    and nparents.get(id(stage.exchange), 1) == 1 \
+                    and nparents.get(id(stage.partial), 1) == 1:
+                schema = (verify(stage.partial.child, resolver)
+                          if resolver is not None else None)
+                if sg.fused_static_eligible(stage, schema):
+                    for nd in (node, stage.exchange, stage.partial):
+                        consumed.add(id(nd))
+                    out.append({"kind": "fused-stage", "stage": stage,
+                                "node": node, "path": paths[id(node)]})
+                    continue
+        if not cfg.fuse:
             continue
         if isinstance(node, Aggregate):
             scan = _stream_scan_of(node)
@@ -897,13 +927,38 @@ def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
 
     ``ndev`` is the mesh size the exchange entries assume (default: the
     runtime ``len(jax.devices())`` at call time — pass it explicitly to
-    model a target mesh from a different host).  The two per-hash-exchange
-    entries are an UPPER bound: ``_exec_exchange`` also early-outs on an
-    EMPTY input table, paying zero syncs where this model charges two.
+    model a target mesh from a different host).  The budget is EXACT, not
+    an upper bound: ``_hash_exchange`` no longer early-outs on an empty
+    input (the PR 8 review discrepancy, closed — a 0-row exchange runs
+    the same two-sync shuffle over its empty planes), and the fused stage
+    pays its one boundary compaction even for empty inputs via
+    ``segment.fused_pad``'s dead-row synthesis.  A ``fused-stage`` entry
+    charges exactly one ``groupby-compaction`` for the whole sandwich
+    (partial + exchange + combine), plus one ``exchange-counts-sizing``
+    when AQE is on and the exchange carries the ``_aqe_split`` stamp (the
+    escape-hatch probe ALWAYS pays its counts fetch before picking the
+    fused or host program).  The overflow/AQE-routed host fallbacks are
+    runtime re-plans outside this static model.  One upper-bound case
+    remains: an agg SEGMENT whose input turns out empty at runtime falls
+    back to the interpreted groupby and pays no sync where this model
+    charges one — the fused stage closes exactly that gap for the
+    distributed sandwich via its dead-row synthesis.
     """
+    from ..utils.config import config as _config
     resolver = resolver or SchemaResolver()
     entries: list = []
-    for s in plan_segments(plan, cfg):
+    fused_exchanges: set = set()
+    for s in plan_segments(plan, cfg, ndev=ndev, resolver=resolver):
+        if s["kind"] == "fused-stage":
+            stage, path = s["stage"], s["path"]
+            fused_exchanges.add(id(stage.exchange))
+            aqe = getattr(cfg or _config, "aqe", False)
+            if aqe and getattr(stage.exchange, "_aqe_split", False):
+                entries.append({"site": "exchange-counts-sizing",
+                                "path": path, "count": 1})
+            entries.append({"site": "groupby-compaction", "path": path,
+                            "count": 1})
+            continue
         seg, path = s["segment"], s["path"]
         if not _statically_eligible(seg, resolver):
             entries.append({"site": "interpreted-fallback", "path": path,
@@ -923,17 +978,20 @@ def sync_budget(plan: PlanNode, resolver: Optional[SchemaResolver] = None,
     # hash exchanges pay one counts-sizing fetch (phase 1 of the two-phase
     # shuffle) and one ok-mask compaction fetch each; broadcast replication
     # is a pure device_put and pays none.  On a 1-device mesh _exec_exchange
-    # degenerates to the identity and skips both.
+    # degenerates to the identity and skips both.  An exchange lowered into
+    # a fused stage is charged by its fused-stage entry above, never here.
     if ndev is None:
         import jax
         ndev = len(jax.devices())
     if ndev > 1:
-        for e in plan_exchanges(plan):
-            if e["kind"] == "hash":
+        paths = node_paths(plan)
+        for n in topo_nodes(plan):
+            if isinstance(n, Exchange) and n.kind == "hash" \
+                    and id(n) not in fused_exchanges:
                 entries.append({"site": "exchange-counts-sizing",
-                                "path": e["path"], "count": 1})
+                                "path": paths[id(n)], "count": 1})
                 entries.append({"site": "exchange-compaction",
-                                "path": e["path"], "count": 1})
+                                "path": paths[id(n)], "count": 1})
     return entries
 
 
@@ -1050,6 +1108,74 @@ def lint_segment(seg, input_table, builds: tuple = ()) -> dict:
     return report
 
 
+def lint_fused_stage(stage, input_table, mesh=None, axis=None) -> dict:
+    """Lower a fused stage's whole ``jit(shard_map(...))`` program to a
+    jaxpr WITHOUT executing it and lint the artifact: trace must succeed,
+    no forbidden host-callback primitives anywhere (including inside the
+    collectives), static output shapes, and the ``all_to_all`` collective
+    must actually be present — a fused stage whose exchange traced away
+    would silently compute shard-local answers."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import ROW_AXIS, axis_size, make_mesh
+    from . import segment as sg
+    axis = axis or ROW_AXIS
+    report = {"fingerprint": stage.fingerprint()[:12], "ok": True,
+              "violations": [], "primitives": 0}
+    if mesh is None:
+        ndev = len(jax.devices())
+        if ndev <= 1:
+            report["skipped"] = ("single-device process: no mesh to lower "
+                                 "the shard_map program on")
+            return report
+        mesh = make_mesh(ndev)
+    ndev = axis_size(mesh, axis)
+    padded, _ = sg.fused_pad(input_table.select(stage.sel_names()), ndev)
+    in_dtypes = tuple(c.dtype for c in padded.columns)
+    key_dtypes = tuple(padded.column(k).dtype for k in stage.combine.keys)
+    # a fresh entry, NOT cache.get: linting must not pollute the process
+    # cache with entries whose trace counter the executor never sees
+    compiled = sg.CompiledFusedStage(
+        ("lint",), stage, mesh, axis, in_dtypes, key_dtypes,
+        padded.num_rows // ndev)
+    datas = tuple(c.data for c in padded.columns)
+    masks = tuple(c.validity for c in padded.columns)
+    try:
+        closed = jax.make_jaxpr(compiled.jfn)(
+            datas, masks, jnp.int64(padded.num_rows))
+    except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+        kind = type(e).__name__
+        host = any(t in kind for t in
+                   ("Concretization", "TracerArrayConversion",
+                    "TracerBoolConversion", "TracerIntegerConversion"))
+        report["ok"] = False
+        report["violations"].append({
+            "code": "host-concretization" if host else "trace-failure",
+            "detail": f"{kind}: {e}"[:400]})
+        return report
+    prims = _collect_primitives(closed.jaxpr)
+    report["primitives"] = len(prims)
+    for pname in sorted(set(prims) & _FORBIDDEN_PRIMITIVES):
+        report["ok"] = False
+        report["violations"].append({"code": "forbidden-primitive",
+                                     "detail": pname})
+    if "all_to_all" not in prims:
+        report["ok"] = False
+        report["violations"].append({
+            "code": "missing-collective",
+            "detail": "fused stage lowered without an all_to_all — the "
+                      "exchange traced away"})
+    for var in closed.jaxpr.outvars:
+        shape = getattr(getattr(var, "aval", None), "shape", ())
+        if not all(isinstance(d, int) for d in shape):
+            report["ok"] = False
+            report["violations"].append({
+                "code": "dynamic-shape",
+                "detail": f"output aval shape {shape} is not static"})
+    return report
+
+
 def lint_plan_artifacts(plan: PlanNode,
                         resolver: Optional[SchemaResolver] = None,
                         rows: int = 8, cfg=None) -> dict:
@@ -1062,7 +1188,21 @@ def lint_plan_artifacts(plan: PlanNode,
     resolver = resolver or SchemaResolver()
     reports: list = []
     violations: list = []
-    for s in plan_segments(plan, cfg):
+    for s in plan_segments(plan, cfg, resolver=resolver):
+        if s["kind"] == "fused-stage":
+            stage = s["stage"]
+            schema = verify(stage.partial.child, resolver)
+            tbl = _zero_table(schema, rows)
+            if tbl is None:
+                reports.append({"path": s["path"], "kind": s["kind"],
+                                "skipped": "input schema unknown"})
+                continue
+            rep = lint_fused_stage(stage, tbl)
+            rep["path"], rep["kind"] = s["path"], s["kind"]
+            reports.append(rep)
+            violations += [{**v, "path": s["path"]}
+                           for v in rep.get("violations", ())]
+            continue
         seg = s["segment"]
         schema = verify(seg.input, resolver)
         tbl = _zero_table(schema, rows)

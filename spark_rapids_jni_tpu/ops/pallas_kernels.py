@@ -116,14 +116,22 @@ def available() -> bool:
     toy single-block kernel: deployments exist (axon remote-compile, r4)
     where a trivial no-grid kernel compiles but every gridded pallas_call is
     rejected by the compile helper — a single-block probe would report
-    available and then fail on first real use."""
+    available and then fail on first real use.
+
+    The probe must run EAGERLY even when first consulted inside a jit
+    trace (``ensure_compile_time_eval``): otherwise the probe kernel is
+    staged into the CALLER's program instead of compiling here, the
+    Mosaic rejection surfaces at the caller's lowering — outside this
+    try — and a backend with no Pallas support reports available."""
     try:
-        n = 2 * _BLOCK_ALIGN
-        mat = jnp.zeros((12, n), jnp.uint32)
-        # force block_rows = _BLOCK_ALIGN so the grid is genuinely 2 blocks
-        # (interleave_planes would auto-pick one block at this size)
-        out = _pallas_call(12, n, _BLOCK_ALIGN, True, False)(mat)
-        np.asarray(out)
+        with jax.ensure_compile_time_eval():
+            n = 2 * _BLOCK_ALIGN
+            mat = jnp.zeros((12, n), jnp.uint32)
+            # force block_rows = _BLOCK_ALIGN so the grid is genuinely 2
+            # blocks (interleave_planes would auto-pick one block at this
+            # size)
+            out = _pallas_call(12, n, _BLOCK_ALIGN, True, False)(mat)
+            np.asarray(out)
         return True
     except Exception:
         return False

@@ -26,6 +26,8 @@ environment flags read once at import:
 | ``SRJT_DIST``         | ``0``   | partitioning-aware distributed planning (Exchange placement rules) |
 | ``SRJT_BROADCAST_ROWS`` | ``100000`` | broadcast-join threshold: estimated build rows at or under this replicate instead of shuffling |
 | ``SRJT_AQE``          | ``0``   | adaptive query execution (engine/adaptive.py): runtime broadcast flip, hot-key skew split, profile-warmed planning |
+| ``SRJT_FUSE_EXCHANGE`` | ``0``  | whole-stage exchange fusion: lower the partial-agg -> hash Exchange -> final-agg sandwich into ONE pjit/shard_map program (engine/segment.py fused stage) |
+| ``SRJT_FUSE_GROUPS`` | ``4096`` | fused stage's static per-shard live-group budget: sizes the in-program exchange (prefix + per-dest capacity); a shard aggregating more groups trips the device-side overflow counter and the stage re-plans on the host path |
 | ``SRJT_AQE_SKEW``     | ``4.0`` | skew (max/mean device load) above which a hash exchange splits its hot keys round-robin |
 | ``SRJT_AQE_BROADCAST_ROWS`` | ``-1`` | measured-rows threshold for the runtime broadcast flip (``-1`` = follow ``SRJT_BROADCAST_ROWS``) |
 | ``SRJT_PROFILE_DIR``  | *(unset)* | persist one compact query profile JSON per query into this dir (utils/profile.py; empty = off) |
@@ -111,6 +113,8 @@ class Config:
     distribute: bool = False     # Exchange-placement distributed planning
     broadcast_rows: int = 100_000  # broadcast-join build-size threshold (rows)
     aqe: bool = False            # adaptive execution (engine/adaptive.py)
+    fuse_exchange: bool = False  # in-program exchange (fused dist stage)
+    fuse_groups: int = 4096      # fused stage's static per-shard group cap
     aqe_skew: float = 4.0        # skew threshold for the hot-key split
     aqe_broadcast_rows: int = -1  # runtime flip threshold (-1 = follow
     #                               broadcast_rows)
@@ -159,6 +163,8 @@ class Config:
             distribute=_bool_flag("SRJT_DIST", False),
             broadcast_rows=_int_flag("SRJT_BROADCAST_ROWS", 100_000),
             aqe=_bool_flag("SRJT_AQE", False),
+            fuse_exchange=_bool_flag("SRJT_FUSE_EXCHANGE", False),
+            fuse_groups=_int_flag("SRJT_FUSE_GROUPS", 4096, minimum=1),
             aqe_skew=_float_flag("SRJT_AQE_SKEW", 4.0, minimum=1.0),
             aqe_broadcast_rows=_int_flag("SRJT_AQE_BROADCAST_ROWS", -1,
                                          minimum=-1),

@@ -77,7 +77,8 @@ PKG = "spark_rapids_jni_tpu"
 
 #: file (repo-relative) -> function names whose bodies are jax-traced
 TRACED_FUNCS = {
-    f"{PKG}/engine/segment.py": {"_build_fn", "_probe_join_node"},
+    f"{PKG}/engine/segment.py": {"_build_fn", "_probe_join_node",
+                                 "_build_fused_fn"},
     f"{PKG}/engine/executor.py": {"_eval_expr"},
 }
 
@@ -513,6 +514,28 @@ def dispatch_pass() -> list:
 #: site (docs/OBSERVABILITY.md's "3 deliberate host syncs")
 SMOKE_EXPECTED_SYNCS = 3
 
+#: the fused dist smoke sandwich's exact budget: the whole partial-agg ->
+#: hash-exchange -> final-agg stage is ONE shard_map program paying ONE
+#: groupby-compaction boundary sync (the host-orchestrated path pays 4)
+FUSED_SMOKE_EXPECTED_SYNCS = 1
+
+
+def _fused_plan(tmp: str):
+    """The dist smoke sandwich for the fused-exchange jaxpr lint."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_jni_tpu.engine import Aggregate, Scan
+    rng = np.random.default_rng(13)
+    n = 4000
+    fact = os.path.join(tmp, "lint_fused.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 512, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 400, n) * 0.25),
+    }), fact)
+    return Aggregate(Scan(fact), ("k",),
+                     (("v", "sum"), ("v", "count")), ("total", "n"))
+
 
 def _full_plans(tmp: str):
     """The nightly extension: bench-shaped join + top-k plans."""
@@ -582,6 +605,47 @@ def segments_pass(full: bool = False) -> list:
             nseg = sum(1 for s in rep["segments"] if "skipped" not in s)
             print(f"srjt-lint: {name}: {nseg} segment artifact(s) linted, "
                   f"{len(rep['violations'])} violation(s)")
+
+        # the fused-exchange artifact: optimize the dist smoke sandwich
+        # under SRJT_FUSE_EXCHANGE and lint the whole jit(shard_map)
+        # program (verify.lint_fused_stage: no callbacks, no host
+        # concretization inside the collectives, all_to_all present) plus
+        # its exact one-sync budget
+        import jax
+        from spark_rapids_jni_tpu.utils.config import config as _cfg
+        saved = _cfg.fuse_exchange
+        _cfg.fuse_exchange = True
+        try:
+            fused_opt = optimize(_fused_plan(tmp), distribute=True)
+            entries, bad = check_sync_budget([fused_opt])
+            for e in bad:
+                out.append(_violation(
+                    "unwhitelisted-host-sync", "<dist-fused>", 0,
+                    f"{e['site']} at {e['path']}"))
+            fused_syncs = sum(e["count"] for e in entries)
+            ndev = len(jax.devices())
+            if ndev > 1 and fused_syncs != FUSED_SMOKE_EXPECTED_SYNCS:
+                out.append(_violation(
+                    "sync-budget-mismatch", "<dist-fused>", 0,
+                    f"fused smoke budget {fused_syncs} syncs, expected "
+                    f"{FUSED_SMOKE_EXPECTED_SYNCS} "
+                    f"({[(e['site'], e['count']) for e in entries]})"))
+            rep = lint_plan_artifacts(fused_opt)
+            for v in rep["violations"]:
+                out.append(_violation(v["code"], "<plan:dist-fused>", 0,
+                                      f"{v.get('path', '?')}: "
+                                      f"{v.get('detail', '')}"))
+            fused_arts = [s for s in rep["segments"]
+                          if s.get("kind") == "fused-stage"]
+            if ndev > 1 and not any("skipped" not in s for s in fused_arts):
+                out.append(_violation(
+                    "missing-fused-artifact", "<plan:dist-fused>", 0,
+                    "no fused-stage jaxpr linted on a multi-device mesh"))
+            print(f"srjt-lint: dist-fused: "
+                  f"{len(fused_arts)} fused-stage artifact(s), budget "
+                  f"{fused_syncs} sync(s) on {ndev} device(s)")
+        finally:
+            _cfg.fuse_exchange = saved
     return out
 
 
@@ -604,6 +668,11 @@ def main(argv=None) -> int:
 
     # import-time passes need the engine importable without a device
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.segments or args.full:
+        # the fused-exchange artifact needs a multi-device mesh to lower
+        # its shard_map program; must be set before jax initializes
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=8")
     sys.path.insert(0, REPO)
     from spark_rapids_jni_tpu.engine.verify import SYNC_WHITELIST
 
