@@ -229,6 +229,48 @@ def main(argv=None) -> int:
     os.environ.pop("SRJT_FAULTS", None)
     refresh()
 
+    # device-decode path under injection (SRJT_DEVICE_DECODE=1): the
+    # chunked plan with the parquet.device_decode transfer seam faulted.
+    # A one-shot transient is absorbed by the retry ladder; a persistent
+    # fault and an OOM must re-plan the chunk onto the host decoder —
+    # every case ends in bit-exact parity with the fault-free oracle,
+    # never a FATAL, and the device path must prove it actually engaged
+    # (counter delta > 0) so the scenario can't silently soak nothing
+    from spark_rapids_jni_tpu.utils import metrics as _metrics
+    os.environ["SRJT_DEVICE_DECODE"] = "1"
+    dd0 = _metrics.snapshot()["counters"].get("io.device_decode.chunks", 0)
+    for spec in ("parquet.device_decode:1:io_error",
+                 "parquet.device_decode:*:io_error",
+                 "parquet.device_decode:1:oom"):
+        os.environ["SRJT_FAULTS"] = spec
+        refresh()
+        faults.reset()
+        runs += 1
+        tag = f"device-decode [{spec}]"
+        try:
+            out = execute(plans[1][1])
+        except Exception as e:  # noqa: BLE001 — the soak classifies
+            kind, _ = errors.classify(e)
+            if kind == errors.KIND_FATAL:
+                failures.append(f"{tag}: FATAL {type(e).__name__}: {e}")
+            else:
+                outcomes_typed += 1
+                print(f"  {tag}: typed error ({kind}) {type(e).__name__}")
+        else:
+            if _parity(oracle["chunked"], out, "ss_store_sk"):
+                outcomes_parity += 1
+                print(f"  {tag}: parity under injection")
+            else:
+                failures.append(f"{tag}: result diverged from oracle")
+    dd1 = _metrics.snapshot()["counters"].get("io.device_decode.chunks", 0)
+    if _metrics.enabled() and dd1 <= dd0:
+        failures.append("device-decode: scenario never engaged the device "
+                        "path (io.device_decode.chunks did not move)")
+    os.environ.pop("SRJT_DEVICE_DECODE", None)
+    os.environ.pop("SRJT_FAULTS", None)
+    refresh()
+    faults.reset()
+
     # concurrent-clients scenario: the fault matrix under multi-tenant
     # contention (engine/scheduler.py).  Four bridge clients run four
     # distinct-fingerprint plans at once against a real subprocess server

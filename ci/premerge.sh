@@ -235,17 +235,20 @@ print("prometheus scrape: %d samples parse as text exposition" % samples)
 '
 
 # bench regression gate: ENFORCED for the smoke-line ratio keys that have
-# soaked since PR 5 plus the serving keys promoted r7 after their r6
-# report-only soak (--enforce-keys allowlist — a regression or a silently
+# soaked since PR 5, the serving keys promoted r7 after their r6
+# report-only soak, and fused_stage.vs_host_exchange promoted r8 after
+# its r7 soak (--enforce-keys allowlist — a regression or a silently
 # dropped key among them fails premerge); every other enrolled key,
-# including the PR-8 dist ratios, the profile-derived keys, and the new
-# r7 fused_stage.vs_host_exchange / row_conversion.roofline_frac keys,
-# stays report-only in the same run.  --profiles folds the query-profile
-# store into the artifact (profile.exchange.skew, profile.chunk_latency.p99).
+# including the PR-8 dist ratios, the profile-derived keys,
+# row_conversion.roofline_frac, and the new r8 device-decode keys
+# (parquet.device_vs_host, parquet.link_ratio — backend-dependent, see
+# BENCH_BASELINES.json), stays report-only in the same run.  --profiles
+# folds the query-profile store into the artifact
+# (profile.exchange.skew, profile.chunk_latency.p99).
 python ci/bench_gate.py --artifact target/smoke-artifact.json \
     --profiles target/smoke-profiles \
     --enforce \
-    --enforce-keys engine_pipeline_smoke.ratios.fused_vs_interp,engine_join_smoke.ratios.cached_vs_per_chunk,serving.p99_ms,serving.throughput,serving.shed_count
+    --enforce-keys engine_pipeline_smoke.ratios.fused_vs_interp,engine_join_smoke.ratios.cached_vs_per_chunk,serving.p99_ms,serving.throughput,serving.shed_count,fused_stage.vs_host_exchange
 
 # end-to-end trace join (docs/OBSERVABILITY.md): a clean query's
 # client-minted trace id must reach the server's OP_METRICS summary and

@@ -988,12 +988,24 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
         if seg is not None:
             joins = seg.joins()
             build_tables = tuple(memo[id(j.right)] for j in joins)
-            it = reader.iter_staged()
+            device_mode = bool(config.device_decode)
+            if device_mode:
+                from ..ops import parquet_decode as pqd
+                it = reader.iter_device()
+            else:
+                it = reader.iter_staged()
             first = next(it, None)
             veto = False
             first_preps: tuple = ()
             if first is not None:
-                if not sg.stream_runtime_eligible(seg, first[0],
+                if device_mode:
+                    # a 1-row probe table carries the geometry's schema so
+                    # eligibility is decided WITHOUT decoding the chunk
+                    probe = pqd.probe_table(first[1].geom) \
+                        if first[0] == "dev" else first[1][0]
+                else:
+                    probe = first[0]
+                if not sg.stream_runtime_eligible(seg, probe,
                                                   build_tables):
                     veto = True  # schema veto: strings/nested in compute
                 else:
@@ -1006,7 +1018,10 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
             if veto:
                 from ..ops.selection import slice_table
                 seg = None
-                for chunk, nvalid in _chain_one(first, it):
+                items = _chain_one(first, it)
+                if device_mode:
+                    items = (_dev_item_host(i, reader) for i in items)
+                for chunk, nvalid in items:
                     ctx.recovery.checkpoint()
                     if nvalid < chunk.num_rows:
                         chunk = slice_table(chunk, 0, nvalid)
@@ -1016,35 +1031,99 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
                 stats["nodes"] += len(seg.chain)  # agg counted by _exec
                 qm = metrics.current()
                 preps = first_preps
-                for chunk, nvalid in _chain_one(first, it) \
+                dd = dd_entry = None
+                if device_mode:
+                    from ..utils.errors import (ResourceExhaustedError,
+                                                TransientError, retry_call)
+                    from . import adaptive
+                    dd = {"device_chunks": 0, "host_chunks": 0, "rows": 0,
+                          "link_bytes": 0, "uncompressed_bytes": 0,
+                          "reasons": {}}
+                    dd_entry = adaptive.record(
+                        ctx.root, {"kind": "scan:device_decode",
+                                   "node": node_label(scan)})
+                for item in _chain_one(first, it) \
                         if first is not None else ():
                     ctx.recovery.checkpoint()
                     stats["chunks"] += 1
-                    ctx.recovery.charge(table_nbytes(chunk))
                     tc0 = time.perf_counter() if qm is not None else 0.0
                     if fused:  # chunks after the first hit the cache
                         preps = _get_builds(joins, build_tables)
-                    fused_compiled = sg.SEGMENT_CACHE.get(seg, chunk,
-                                                          build_tables)
-                    with op_scope("engine.fused_segment"):
-                        fused.append(fused_compiled(chunk, nvalid, preps))
+                    if device_mode:
+                        kind, payload, reason = item
+                        planes = None
+                        if kind == "dev":
+                            try:
+                                planes = retry_call(
+                                    payload.to_device,
+                                    "parquet.device_decode",
+                                    cancel=ctx.recovery.cancel)
+                            except (TransientError,
+                                    ResourceExhaustedError, OSError):
+                                # persistent link failure: this one group
+                                # re-plans onto the host oracle (results
+                                # identical); cancellation is not caught —
+                                # QueryCancelledError unwinds as usual
+                                metrics.count("io.device_decode.fallbacks")
+                                kind, reason = "host", "transfer_error"
+                                payload = _dev_item_host(item, reader)
+                        if kind == "dev":
+                            ctx.recovery.charge(payload.comp_bytes)
+                            fused_compiled = sg.SEGMENT_CACHE.get_decode(
+                                seg, payload.geom, build_tables)
+                            with op_scope("engine.fused_segment"):
+                                fused.append(fused_compiled(
+                                    planes, payload.nrows, preps))
+                            nvalid, padded = payload.nrows, 0
+                            cb = payload.comp_bytes
+                            dd["device_chunks"] += 1
+                            dd["link_bytes"] += int(payload.comp_bytes)
+                            dd["uncompressed_bytes"] += \
+                                int(payload.unc_bytes)
+                        else:
+                            chunk, nvalid = payload
+                            if reason is not None:
+                                dd["reasons"][reason] = \
+                                    dd["reasons"].get(reason, 0) + 1
+                            dd["host_chunks"] += 1
+                            cb = table_nbytes(chunk)
+                            padded = chunk.num_rows - nvalid
+                            ctx.recovery.charge(cb)
+                            fused_compiled = sg.SEGMENT_CACHE.get(
+                                seg, chunk, build_tables)
+                            with op_scope("engine.fused_segment"):
+                                fused.append(fused_compiled(
+                                    chunk, nvalid, preps))
+                    else:
+                        chunk, nvalid = item
+                        cb = table_nbytes(chunk)
+                        padded = chunk.num_rows - nvalid
+                        ctx.recovery.charge(cb)
+                        fused_compiled = sg.SEGMENT_CACHE.get(seg, chunk,
+                                                              build_tables)
+                        with op_scope("engine.fused_segment"):
+                            fused.append(fused_compiled(chunk, nvalid,
+                                                        preps))
                     if qm is not None:
                         # per-chunk latency is dispatch time — the fused
                         # loop never syncs per chunk, by design
                         dt = time.perf_counter() - tc0
-                        cb = table_nbytes(chunk)
                         qm.node_add(id(agg), node_label(agg), chunks=1,
                                     rows_in=int(nvalid),
                                     bytes_in=cb,
-                                    padded_rows=int(chunk.num_rows - nvalid))
+                                    padded_rows=int(padded))
                         qm.progress_step(chunks=1, rows=int(nvalid),
                                          nbytes=cb)
                         metrics.observe("engine.stream.chunk_latency_s", dt)
                         metrics.observe("engine.stream.chunk_rows",
                                         int(nvalid))
                         metrics.mem_checkpoint()
+                    if dd is not None:
+                        dd["rows"] += int(nvalid)
                 if fused:
                     stats["fused_segments"] += 1
+                if dd is not None:
+                    _finish_device_decode(dd, dd_entry, scan, qm)
         else:
             for chunk in reader:
                 ctx.recovery.checkpoint()
@@ -1059,10 +1138,10 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
         return sg.combine_partials(fused, fused_compiled)
     if not partials:
         # everything pruned/filtered: run the plan once on an empty chunk
-        # so the output schema still comes out right
-        from ..io import ParquetFile
+        # so the output schema still comes out right (the reader's cached
+        # footer serves the schema — no second file open/parse)
         sub = _ChunkMemo(memo)
-        sub[id(scan)] = ParquetFile(scan.path).empty_table(cols)
+        sub[id(scan)] = reader.file.empty_table(cols)
         return _groupby(_exec(agg.child, sub, stats, ctx), agg)
 
     merged = partials[0] if len(partials) == 1 else concat_tables(partials)
@@ -1074,6 +1153,42 @@ def _exec_streamed(agg: Aggregate, scan: Scan, memo: dict,
 def _chain_one(first, rest):
     yield first
     yield from rest
+
+
+def _dev_item_host(item, reader):
+    """Normalize a device-stream item to ``(padded Table, nvalid)``.
+
+    Host-fallback items pass through; device page chunks re-plan onto the
+    host decoder, landing in the same staged shape class as any other
+    fallback group.  A device group always fits one pass budget (oversized
+    groups never planned as device chunks), so no re-slicing is needed.
+    """
+    kind, payload, _ = item
+    if kind == "host":
+        return payload
+    return reader._stage_one(
+        reader.file._decode_group(payload.gi, reader.columns))
+
+
+def _finish_device_decode(dd: dict, dd_entry, scan: Scan, qm) -> None:
+    """Stamp the stream's decode routing into ledger + query metrics.
+
+    ``decode=`` is what EXPLAIN ANALYZE renders on the scan node; the
+    link/uncompressed byte totals let it derive the wire-compression win
+    without any extra bookkeeping."""
+    from . import adaptive
+    dev, host = dd["device_chunks"], dd["host_chunks"]
+    choice = "device" if host == 0 and dev > 0 else \
+        ("host" if dev == 0 else "mixed")
+    adaptive.update(dd_entry, choice=choice, device_chunks=dev,
+                    host_chunks=host, link_bytes=dd["link_bytes"],
+                    uncompressed_bytes=dd["uncompressed_bytes"],
+                    reasons=dict(dd["reasons"]))
+    if qm is not None:
+        qm.node_set(id(scan), node_label(scan), decode=choice,
+                    rows_in=dd["rows"], rows_out=dd["rows"],
+                    link_bytes=dd["link_bytes"],
+                    unc_bytes=dd["uncompressed_bytes"])
 
 
 class _ChunkMemo(dict):

@@ -165,6 +165,17 @@ def _annotate(span: Optional[dict], ceiling: Optional[float] = None,
             bits.append(f"exch_GB/s={gbps:.3f}")
             if ceiling:
                 bits.append(f"exch_roofline_frac={gbps / ceiling:.6f}")
+    if span.get("decode"):
+        # SRJT_DEVICE_DECODE routing verdict on a scan: which side decoded
+        # the pages, what the link carried vs what the host path would
+        # have shipped (link_ratio < 1 is the wire win)
+        bits.append(f"decode={span['decode']}")
+        link, unc = int(span.get("link_bytes", 0) or 0), \
+            int(span.get("unc_bytes", 0) or 0)
+        if link:
+            bits.append(f"link_bytes={link}")
+            if unc:
+                bits.append(f"link_ratio={link / unc:.3f}")
     if span.get("in_program"):
         # the node ran INSIDE a fused whole-stage program (whole-stage
         # fusion, SRJT_FUSE_EXCHANGE): its collectives paid no host

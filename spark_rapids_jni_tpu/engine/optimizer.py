@@ -624,6 +624,26 @@ def _stamp_evidence(plan: PlanNode, decisions: list, dist: bool) -> None:
     object.__setattr__(plan, "_decisions", decisions)
 
 
+def _stamp_device_decode(plan: PlanNode, decisions: list) -> None:
+    """Mark parquet scans as page-routed under ``SRJT_DEVICE_DECODE``.
+
+    The distributed planner must know that a device-decoded Scan ships
+    compressed pages to the device that decodes them — its output is
+    placed at page granularity (``Partitioning("pages")``), not an
+    unknown single stream, so key-sensitive boundaries above it still
+    plan their exchanges while row-local chains stay fused.  A plain
+    attribute stamp (like the AQE eligibility stamps): fingerprints stay
+    byte-identical, and the executor falls back per-chunk at runtime for
+    geometries the kernels can't take — the stamp records ROUTING intent,
+    which the runtime ledger entry then confirms or overrides.
+    """
+    for n in topo_nodes(plan):
+        if isinstance(n, Scan) and n.format == "parquet":
+            object.__setattr__(n, "_decode_pages", True)
+            decisions.append({"kind": "scan:device_decode",
+                              "choice": "page_routed"})
+
+
 def optimize(plan: PlanNode,
              distribute: Optional[bool] = None) -> PlanNode:
     """Apply all rewrite rules; returns a new plan (input untouched).
@@ -684,6 +704,11 @@ def optimize(plan: PlanNode,
     plan = _apply_pruning(plan, schema, req, {})
     if checker is not None:
         checker.check("prune_projections", plan)
+    if dist and config.device_decode:
+        # after the last structural pass (stamps don't survive rebuilds),
+        # before check_partitioning/_stamp_evidence so the "pages"
+        # placement is verified and the ledger entries get census paths
+        _stamp_device_decode(plan, decisions)
     if dist and config.verify:
         from .verify import check_partitioning
         check_partitioning(plan)

@@ -478,9 +478,13 @@ class Partitioning:
     """How a node's output rows are placed across the mesh.
 
     ``kind`` is ``"none"`` (unknown / single stream), ``"hash"`` (rows placed
-    by murmur3/pmod of ``keys``), or ``"broadcast"`` (every device holds a
-    full replica).  Compared structurally — ``keys`` order is significant
-    because placement hashes the key *tuple* positionally.
+    by murmur3/pmod of ``keys``), ``"broadcast"`` (every device holds a
+    full replica), or ``"pages"`` (a device-decoded scan: rows land where
+    their compressed pages were shipped, page/row-group granular — a real
+    placement, but never co-partitioned with anything, so it degrades like
+    ``"none"`` at any key-sensitive boundary).  Compared structurally —
+    ``keys`` order is significant because placement hashes the key *tuple*
+    positionally.
     """
     kind: str = "none"
     keys: Tuple[str, ...] = ()
@@ -506,8 +510,14 @@ def partitioning(node: PlanNode, _memo: Optional[dict] = None) -> Partitioning:
         p = (BROADCAST_PARTITIONING if node.kind == "broadcast"
              else Partitioning("hash", node.keys))
     elif isinstance(node, Scan):
-        p = (Partitioning("hash", node.partitioned_by)
-             if node.partitioned_by else NO_PARTITIONING)
+        if node.partitioned_by:
+            p = Partitioning("hash", node.partitioned_by)
+        elif getattr(node, "_decode_pages", False):
+            # device-decoded scan: rows sit wherever their compressed
+            # pages were shipped — page-granular placement, no key claim
+            p = Partitioning("pages", ())
+        else:
+            p = NO_PARTITIONING
     elif isinstance(node, (Filter, Sort, Limit, TopK)):
         # row-local / row-dropping operators never move surviving rows
         p = partitioning(node.child, memo)
@@ -517,7 +527,11 @@ def partitioning(node: PlanNode, _memo: Optional[dict] = None) -> Partitioning:
             p = NO_PARTITIONING
     elif isinstance(node, Aggregate):
         p = partitioning(node.child, memo)
-        if p.kind == "hash" and not set(p.keys) <= set(node.keys):
+        if p.kind == "pages":
+            # page placement says nothing about group keys: a keyed
+            # aggregate over it is a single-stream combine, not aligned
+            p = NO_PARTITIONING
+        elif p.kind == "hash" and not set(p.keys) <= set(node.keys):
             p = NO_PARTITIONING
         elif p.kind == "broadcast" and node.keys:
             # every device would compute identical full groups — replicated

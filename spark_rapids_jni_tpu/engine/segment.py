@@ -426,6 +426,43 @@ class CompiledSegment:
         return out
 
 
+def _build_decode_fn(seg: Segment, compiled: "CompiledSegment", geom):
+    """Scan decode fused into the segment: ONE traced program that takes
+    the compressed page planes (io/parquet.py DevicePageChunk wire form),
+    decodes them on-device (ops/parquet_decode.py) and runs the segment
+    chain on the result — decompress -> unpack -> filter/project/agg with
+    no host boundary anywhere in between.  Page-table sizing is trace-time
+    static (the geometry came from footer metadata), so the program adds
+    ZERO deliberate host syncs over the plain segment."""
+    from ..ops.parquet_decode import decode_table
+    inner = _build_fn(seg, compiled)
+
+    def fn(planes, nvalid, prepared=()):
+        return inner(decode_table(planes, geom), nvalid, prepared)
+
+    return fn
+
+
+class CompiledDecodeSegment(CompiledSegment):
+    """A CompiledSegment whose jitted program starts at the page planes.
+
+    ``__call__`` is inherited: the executor always passes ``nvalid``
+    explicitly (the planes pytree has no ``num_rows``), and the planes
+    ride in the table slot."""
+
+    __slots__ = ("geom",)
+
+    def __init__(self, key: tuple, segment: Segment, key_dtypes: tuple,
+                 geom):
+        self.key = key
+        self.segment = segment
+        self.key_dtypes = key_dtypes
+        self.traces = 0
+        self.calls = 0
+        self.geom = geom
+        self.jfn = jax.jit(_build_decode_fn(segment, self, geom))
+
+
 def _resolve_dtype(name: str, table: Table, builds: tuple):
     """Dtype of an agg key that may come off a join's build side (raw name
     or with the ``_r`` collision suffix stripped)."""
@@ -481,6 +518,42 @@ class SegmentCache:
         key_dtypes = () if segment.agg is None else tuple(
             _resolve_dtype(k, table, builds) for k in segment.agg.keys)
         compiled = CompiledSegment(key, segment, key_dtypes)
+        with self._lock:
+            racer = self._entries.get(key)
+            if racer is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                metrics.count("engine.segment_cache.hit")
+                return racer
+            self.misses += 1
+            metrics.count("engine.segment_cache.miss")
+            self._entries[key] = compiled
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                metrics.count("engine.segment_cache.eviction")
+            return compiled
+
+    def get_decode(self, segment: Segment, geom,
+                   builds: tuple = ()) -> CompiledDecodeSegment:
+        """The fused scan-decode variant of :meth:`get`: keyed by
+        (fingerprint, page geometry, build shapes) — one executable per
+        (plan segment, page-geometry bucket) class, shared by every chunk
+        whose pages quantize to the same buckets."""
+        key = (segment.fingerprint(), ("device_decode", geom),
+               tuple(shape_class(b) for b in builds))
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                metrics.count("engine.segment_cache.hit")
+                return hit
+        from ..ops.parquet_decode import probe_table
+        key_dtypes = () if segment.agg is None else tuple(
+            _resolve_dtype(k, probe_table(geom), builds)
+            for k in segment.agg.keys)
+        compiled = CompiledDecodeSegment(key, segment, key_dtypes, geom)
         with self._lock:
             racer = self._entries.get(key)
             if racer is not None:

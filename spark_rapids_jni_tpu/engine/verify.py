@@ -765,6 +765,11 @@ def decision_census(plan: PlanNode, dist: bool | None = None) -> list:
     for n in topo_nodes(plan):
         if isinstance(n, TopK):
             out.append({"kind": "topk", "path": paths[id(n)]})
+        elif isinstance(n, Scan) and getattr(n, "_decode_pages", False):
+            # SRJT_DEVICE_DECODE page-routing stamp: the structure IS the
+            # attribute (fingerprint-neutral), but it evidences a planner
+            # decision, so the ledger entry must get a census path too
+            out.append({"kind": "scan:device_decode", "path": paths[id(n)]})
         elif isinstance(n, Exchange):
             if id(n) in partial_exchanges:
                 continue  # owned by the combine Aggregate's split entry
@@ -1082,6 +1087,51 @@ def lint_segment(seg, input_table, builds: tuple = ()) -> dict:
     try:
         closed = jax.make_jaxpr(fn)(
             input_table, jnp.int32(input_table.num_rows), tuple(builds))
+    except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+        kind = type(e).__name__
+        host = any(t in kind for t in
+                   ("Concretization", "TracerArrayConversion",
+                    "TracerBoolConversion", "TracerIntegerConversion"))
+        report["ok"] = False
+        report["violations"].append({
+            "code": "host-concretization" if host else "trace-failure",
+            "detail": f"{kind}: {e}"[:400]})
+        return report
+    prims = _collect_primitives(closed.jaxpr)
+    report["primitives"] = len(prims)
+    for pname in sorted(set(prims) & _FORBIDDEN_PRIMITIVES):
+        report["ok"] = False
+        report["violations"].append({"code": "forbidden-primitive",
+                                     "detail": pname})
+    for var in closed.jaxpr.outvars:
+        shape = getattr(getattr(var, "aval", None), "shape", ())
+        if not all(isinstance(d, int) for d in shape):
+            report["ok"] = False
+            report["violations"].append({
+                "code": "dynamic-shape",
+                "detail": f"output aval shape {shape} is not static"})
+    return report
+
+
+def lint_decode_segment(seg, geom, builds: tuple = ()) -> dict:
+    """`lint_segment` for the fused scan-decode program: lower the
+    decompress -> unpack -> segment chain over ZERO-filled page planes of
+    ``geom`` and lint the one artifact.  The decode prefix is pure array
+    code driven by trace-time-static page tables, so the fused program
+    must carry exactly the segment's own syncs — any forbidden callback
+    or dynamic shape here means the decode path smuggled in a host
+    boundary the plain segment doesn't have."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.parquet_decode import zero_planes
+    from . import segment as sg
+    report = {"fingerprint": seg.fingerprint()[:12], "ok": True,
+              "violations": [], "primitives": 0, "decode": True}
+    fn = sg._build_decode_fn(seg, _TraceProbe(), geom)
+    try:
+        closed = jax.make_jaxpr(fn)(
+            zero_planes(geom), jnp.int32(1), tuple(builds))
     except Exception as e:  # noqa: BLE001 — any trace failure is the finding
         kind = type(e).__name__
         host = any(t in kind for t in

@@ -84,7 +84,9 @@ def _decompress(page: bytes, codec: int, uncompressed_size: int) -> bytes:
             out = _SNAPPY_NATIVE.decompress(
                 page, decompressed_size=uncompressed_size).to_pybytes()
         else:
-            out = snappy.decompress(page)
+            # literal-only pages (high-entropy / dict-encoded data) collapse
+            # to slice copies; anything else hits the byte-exact decoder
+            out = snappy.decompress_fast(page)
         if len(out) != uncompressed_size:
             raise ValueError("snappy page size mismatch")
         return out
@@ -848,6 +850,18 @@ class _ChunkDecoder:
 # public API
 # ---------------------------------------------------------------------------
 
+# Parsed-footer cache: the streaming path opens the same file more than
+# once (the chunked reader for data, the executor's empty-stream fallback
+# for schema), and repeated scans of one file are the NDS norm — parse the
+# footer ONCE per (file identity, version).  The cached value is pure
+# metadata (schema + ChunkMeta offsets), safely shared across mmaps; the
+# key's mtime/size pin it to the exact file version.  ``io.footer_parses``
+# counts actual parses so tests can prove one parse per file.
+_FOOTER_CACHE: dict = {}
+_FOOTER_CACHE_MAX = 64
+_footer_lock = __import__("threading").Lock()
+
+
 class ParquetFile:
     """Metadata handle over one parquet file; decodes row groups on demand."""
 
@@ -859,10 +873,26 @@ class ParquetFile:
             buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         if buf[:4] != _MAGIC or buf[-4:] != _MAGIC:
             raise ValueError(f"{self.path}: not a parquet file")
-        flen = int.from_bytes(buf[-8:-4], "little")
-        meta, _ = decode_struct(buf[-8 - flen:-8])
         self._buf = buf
-        self.schema, self.num_rows, self.row_groups = _parse_footer(meta)
+        key = None
+        try:
+            st = os.stat(self.path)
+            key = (os.path.realpath(self.path), st.st_mtime_ns, st.st_size)
+        except OSError:
+            pass
+        with _footer_lock:
+            cached = _FOOTER_CACHE.get(key) if key is not None else None
+        if cached is None:
+            flen = int.from_bytes(buf[-8:-4], "little")
+            meta, _ = decode_struct(buf[-8 - flen:-8])
+            metrics.count("io.footer_parses")
+            cached = _parse_footer(meta)
+            if key is not None:
+                with _footer_lock:
+                    if len(_FOOTER_CACHE) >= _FOOTER_CACHE_MAX:
+                        _FOOTER_CACHE.pop(next(iter(_FOOTER_CACHE)))
+                    _FOOTER_CACHE[key] = cached
+        self.schema, self.num_rows, self.row_groups = cached
         self.names = [s.name for s in self.schema]
 
     @property
@@ -1061,6 +1091,216 @@ def read_parquet(path, columns=None, staged: bool | None = None) -> Table:
     return ParquetFile(path).read(columns, staged=staged)
 
 
+# ---------------------------------------------------------------------------
+# device-decode page planning (SRJT_DEVICE_DECODE)
+# ---------------------------------------------------------------------------
+
+from ..utils.errors import TransientError as _TransientError  # noqa: E402
+
+
+class TruncatedPageError(_TransientError, OSError):
+    """A page header or body runs past its chunk/file bounds.
+
+    Typed ``io_error`` (transient OSError): storage-layer truncation is
+    indistinguishable from a torn read, so the bounded retry ladder gets a
+    chance before the failure propagates."""
+
+
+class DevicePageChunk:
+    """One row group's raw compressed pages, packed as host numpy planes.
+
+    The device-decode wire unit: ``to_device()`` ships the planes (the
+    *compressed* page bytes plus the tiny per-page count sidecars) and
+    ops/parquet_decode.decode_table turns them into columns on-device.
+    Built host-side — in the prefetch producer thread when the pipeline is
+    double-buffered — so only the transfer + decode land on the consumer's
+    critical path.
+    """
+
+    __slots__ = ("gi", "geom", "planes", "nrows", "comp_bytes", "unc_bytes")
+
+    def __init__(self, gi, geom, planes, nrows, comp_bytes, unc_bytes):
+        self.gi = gi
+        self.geom = geom
+        self.planes = planes          # {col: {plane: np.ndarray}}
+        self.nrows = nrows
+        self.comp_bytes = comp_bytes  # padded plane bytes (the link cost)
+        self.unc_bytes = unc_bytes    # what the host path's transfer ships
+
+    def to_device(self) -> dict:
+        """Transfer the planes; returns the jnp pytree decode_table eats."""
+        faults.check("parquet.device_decode")
+        metrics.count("io.device_decode.chunks")
+        metrics.count("io.device_decode.link_bytes", int(self.comp_bytes))
+        metrics.count("io.device_decode.uncompressed_bytes",
+                      int(self.unc_bytes))
+        return {name: {k: jnp.asarray(v) for k, v in planes.items()}
+                for name, planes in self.planes.items()}
+
+
+def _walk_pages(fbuf, meta: ChunkMeta):
+    """Host page-header walk of one column chunk (payloads untouched).
+
+    Returns ``(data_pages, dict_page, encoding)`` with data_pages =
+    [(body_off, comp_len, unc_len, num_values)], dict_page the same tuple
+    shape with num_values = dictionary size, and encoding the chunk's data
+    encoding class ("plain" | "dict") — or ``(None, None, reason)`` when an
+    encoding/page shape needs the host decoder.  Truncation raises the
+    typed :class:`TruncatedPageError`.
+    """
+    pos = meta.start_offset
+    end = pos + meta.total_compressed
+    remaining = meta.num_values
+    flen = len(fbuf)
+    data_pages, dict_page, encs = [], None, set()
+    while remaining > 0 and pos < end:
+        try:
+            header, body = decode_struct(fbuf, pos)
+        except Exception as e:
+            raise TruncatedPageError(
+                f"{meta.schema.name}: page header at {pos} unreadable") \
+                from e
+        comp = header.get(3)
+        if comp is None or body + comp > end or body + comp > flen:
+            raise TruncatedPageError(
+                f"{meta.schema.name}: page body at {body} overruns chunk")
+        ptype = header[1]
+        if ptype == PAGE_DICTIONARY:
+            dict_page = (body, comp, header[2], header[7][1])
+        elif ptype == PAGE_DATA:
+            ph = header[5]
+            if ph.get(3, ENC_RLE) != ENC_RLE:
+                return None, None, "level_encoding"
+            enc = ph[2]
+            if enc == ENC_PLAIN:
+                encs.add("plain")
+            elif enc in (ENC_PLAIN_DICTIONARY, ENC_RLE_DICTIONARY):
+                encs.add("dict")
+            else:
+                return None, None, "value_encoding"
+            data_pages.append((body, comp, header[2], ph[1]))
+            remaining -= ph[1]
+        elif ptype == PAGE_DATA_V2:
+            return None, None, "v2_pages"
+        elif ptype != PAGE_INDEX:
+            return None, None, "page_type"
+        pos = body + comp
+    if len(encs) != 1:
+        return None, None, ("no_pages" if not encs else "mixed_encoding")
+    encoding = encs.pop()
+    if encoding == "dict" and dict_page is None:
+        return None, None, "no_dictionary"
+    return data_pages, dict_page, encoding
+
+
+def _device_eligible_schema(s: ColumnSchema):
+    """Fallback reason for schema shapes the device decoder won't take,
+    or None when eligible (flat fixed-width, at most one def level)."""
+    if s.is_struct or s.is_list or s.list_levels or s.extra_def:
+        return "nested"
+    if s.max_rep:
+        return "repeated"
+    if s.max_def > 1:
+        return "multi_def"
+    if s.physical == PT_BOOLEAN:
+        return None
+    if s.physical not in _PLAIN_NP or s.dtype.is_string:
+        return "physical_type"
+    if np.dtype(s.dtype.storage).itemsize != _PLAIN_NP[s.physical].itemsize:
+        return "narrowed_type"  # e.g. INT32 physical read as int16
+    return None
+
+
+def plan_device_group(pf: ParquetFile, gi: int, columns=None,
+                      limit: int | None = None):
+    """Plan one row group for device decode: ``(DevicePageChunk, None)`` or
+    ``(None, reason)`` when the group re-plans to the host decoder.
+
+    Pure host metadata work: footer eligibility, a page-header walk
+    (io/thrift.py), a snappy token scan per page (header bytes only), and
+    numpy plane packing.  No page payload is decoded here.
+    """
+    from ..ops import parquet_decode as pqd
+    g = pf.row_groups[gi]
+    idxs = pf._column_indices(columns)
+    for i in idxs:
+        reason = _device_eligible_schema(pf.schema[i])
+        if reason is None and g.chunks[i].codec not in (CODEC_SNAPPY,
+                                                        CODEC_UNCOMPRESSED):
+            reason = "codec"
+        if reason is not None:
+            return None, reason
+    if limit is not None:
+        total_unc = sum(int(g.chunks[i].total_uncompressed or 0)
+                        for i in idxs)
+        if total_unc > limit:
+            # one group must stay one chunk on the device path (pages are
+            # not row-sliceable without decode); oversized groups keep the
+            # host path's budgeted slicing
+            return None, "oversized_group"
+    nrows = int(g.num_rows)
+    rb = pqd.bucket(max(nrows, 1), 1024)
+    fbuf = pf._buf
+    cols, planes = [], {}
+    comp_bytes = unc_bytes = 0
+    for i in idxs:
+        meta = g.chunks[i]
+        s = meta.schema
+        data_pages, dict_page, enc = _walk_pages(fbuf, meta)
+        if data_pages is None:
+            return None, enc
+        np_, cmax, umax, vmax = len(data_pages), 0, 0, 0
+        rows_seen = 0
+        for _, c, u, nv in data_pages:
+            cmax, umax, vmax = max(cmax, c), max(umax, u), max(vmax, nv)
+            rows_seen += nv
+        if rows_seen != nrows:
+            return None, "row_count"
+        if dict_page is not None:
+            cmax = max(cmax, dict_page[1])
+            umax = max(umax, dict_page[2])
+        pcount = pqd.bucket(max(np_, 1), 1)
+        cb, ub = pqd.bucket(cmax), pqd.bucket(umax)
+        vb = pqd.bucket(vmax)
+        db = pqd.bucket(dict_page[3]) if enc == "dict" else pqd.MIN_BUCKET
+        has_copies, tmax = False, 1
+        if meta.codec == CODEC_SNAPPY:
+            view = memoryview(fbuf)
+            bodies = list(data_pages) + \
+                ([dict_page] if dict_page is not None else [])
+            for off, c, _, _ in bodies:
+                ntok, lit_only = snappy.scan_tokens(view[off:off + c])
+                tmax = max(tmax, ntok)
+                if not lit_only:
+                    has_copies = True
+        comp = np.zeros((pcount + 1, cb), np.uint8)
+        clen = np.zeros(pcount + 1, np.int32)
+        ulen = np.zeros(pcount + 1, np.int32)
+        nv_arr = np.zeros(pcount + 1, np.int32)
+        if dict_page is not None:
+            off, c, u, nd = dict_page
+            comp[0, :c] = np.frombuffer(fbuf, np.uint8, c, off)
+            clen[0], ulen[0], nv_arr[0] = c, u, nd
+        for k, (off, c, u, nv) in enumerate(data_pages):
+            comp[k + 1, :c] = np.frombuffer(fbuf, np.uint8, c, off)
+            clen[k + 1], ulen[k + 1], nv_arr[k + 1] = c, u, nv
+        cols.append(pqd.ColumnGeom(
+            name=s.name, dtype=s.dtype, physical=s.physical,
+            codec=meta.codec, encoding=enc, max_def=s.max_def,
+            has_copies=has_copies, npages=pcount, cb=cb, ub=ub, vb=vb,
+            db=db, tb=pqd.bucket(tmax, 16)))
+        # row -> (page, slot) is NOT shipped: the kernel derives it from
+        # the nv cumsum, so the link carries only pages + page counts
+        planes[s.name] = {"comp": comp, "clen": clen, "ulen": ulen,
+                          "nv": nv_arr}
+        comp_bytes += comp.nbytes + clen.nbytes + ulen.nbytes \
+            + nv_arr.nbytes
+        unc_bytes += int(meta.total_uncompressed or 0)
+    geom = pqd.ChunkGeom(columns=tuple(cols), rb=rb)
+    return DevicePageChunk(gi, geom, planes, nrows, comp_bytes,
+                           unc_bytes), None
+
+
 class ParquetChunkedReader:
     """Iterate a parquet file as device Tables bounded by a byte budget.
 
@@ -1163,6 +1403,24 @@ class ParquetChunkedReader:
         faults.check("parquet.chunk")
         return self.file._decode_group(gi, self.columns)
 
+    def _host_slices_group(self, gi: int):
+        """Budget-bounded host-side slices of ONE row group."""
+        # transient decode failures (flaky storage) retry per row
+        # group, bounded by SRJT_RETRY_MAX with backoff
+        hosts = retry_call(
+            lambda gi=gi: self._decode_group_checked(gi),
+            "parquet.chunk", cancel=self.cancel)
+        nrows = hosts[0].num_rows
+        if nrows == 0:
+            return
+        total = sum(h.nbytes_estimate() for h in hosts)
+        metrics.count("io.parquet.bytes_decoded", int(total))
+        per_row = max(1, total // max(nrows, 1))
+        step = max(1, self.limit // per_row)
+        for a in range(0, nrows, step):
+            b = min(a + step, nrows)
+            yield [h.slice(a, b) for h in hosts]
+
     def _host_slices(self):
         """Budget-bounded host-side chunk slices, pre device transfer."""
         for gi in range(self.file.num_row_groups):
@@ -1172,21 +1430,7 @@ class ParquetChunkedReader:
                 self.groups_pruned += 1
                 continue
             self.groups_read += 1
-            # transient decode failures (flaky storage) retry per row
-            # group, bounded by SRJT_RETRY_MAX with backoff
-            hosts = retry_call(
-                lambda gi=gi: self._decode_group_checked(gi),
-                "parquet.chunk", cancel=self.cancel)
-            nrows = hosts[0].num_rows
-            if nrows == 0:
-                continue
-            total = sum(h.nbytes_estimate() for h in hosts)
-            metrics.count("io.parquet.bytes_decoded", int(total))
-            per_row = max(1, total // max(nrows, 1))
-            step = max(1, self.limit // per_row)
-            for a in range(0, nrows, step):
-                b = min(a + step, nrows)
-                yield [h.slice(a, b) for h in hosts]
+            yield from self._host_slices_group(gi)
 
     def _chunks_raw(self):
         for sl in self._host_slices():
@@ -1204,19 +1448,66 @@ class ParquetChunkedReader:
         compile once and mask rows >= n_rows.  Ineligible schemas
         (strings, lists, structs, DECIMAL128) fall back to per-column
         transfers at natural size (n_rows == num_rows)."""
-        from .staging import stage_fixed_table
         for sl in self._host_slices():
-            nrows = sl[0].num_rows
-            metrics.count("io.parquet.chunks")
-            metrics.observe("io.parquet.chunk_rows", nrows)
-            if all(h.values is not None and
-                   h.schema.dtype.id != dt.TypeId.DECIMAL128 for h in sl):
-                specs = [(h.schema.name, h.schema.dtype, h.values,
-                          h.validity) for h in sl]
-                yield stage_fixed_table(specs, padded=True)
+            yield self._stage_one(sl)
+
+    def _stage_one(self, sl):
+        """One host slice -> (padded Table, n_rows) on the staged path."""
+        from .staging import stage_fixed_table
+        nrows = sl[0].num_rows
+        metrics.count("io.parquet.chunks")
+        metrics.observe("io.parquet.chunk_rows", nrows)
+        if all(h.values is not None and
+               h.schema.dtype.id != dt.TypeId.DECIMAL128 for h in sl):
+            specs = [(h.schema.name, h.schema.dtype, h.values,
+                      h.validity) for h in sl]
+            return stage_fixed_table(specs, padded=True)
+        return (Table([h.to_column() for h in sl],
+                      [h.schema.name for h in sl]), nrows)
+
+    def _device_stream(self):
+        """Mixed device/host chunk stream for SRJT_DEVICE_DECODE.
+
+        Yields ``("dev", DevicePageChunk, None)`` for groups the device
+        decoder takes (planes packed host-side, payloads NOT decoded) and
+        ``("host", (Table, n_rows), reason)`` for per-group fallbacks —
+        the executor records the ledgered ``scan:device_decode`` decision
+        either way.  Group order is preserved, so results match the host
+        path row-for-row.
+        """
+        for gi in range(self.file.num_row_groups):
+            if self.cancel is not None:
+                self.cancel.check()
+            if self._group_pruned(gi):
+                self.groups_pruned += 1
+                continue
+            self.groups_read += 1
+            if int(self.file.row_groups[gi].num_rows) == 0:
+                continue
+            chunk, reason = plan_device_group(
+                self.file, gi, self.columns, self.limit)
+            if chunk is not None:
+                metrics.count("io.parquet.chunks")
+                metrics.observe("io.parquet.chunk_rows", chunk.nrows)
+                yield ("dev", chunk, None)
             else:
-                yield (Table([h.to_column() for h in sl],
-                             [h.schema.name for h in sl]), nrows)
+                metrics.count("io.device_decode.fallbacks")
+                for sl in self._host_slices_group(gi):
+                    yield ("host", self._stage_one(sl), reason)
+
+    def iter_device(self, prefetch: int | None = None):
+        """Iterate the device-decode stream, optionally double-buffered.
+
+        Same pipeline shape as :meth:`iter_staged` — with depth >= 1 the
+        producer thread does the page-header walk and plane packing (or the
+        host decode, for fallback groups) for chunk k+1 while the consumer
+        transfers/decodes chunk k on device."""
+        depth = self.prefetch if prefetch is None else int(prefetch)
+        gen = self._device_stream()
+        if depth <= 0:
+            yield from gen
+        else:
+            yield from self._tracked(_prefetched(gen, depth, self.cancel))
 
     def iter_staged(self, prefetch: int | None = None):
         """Iterate ``(padded Table, n_rows)`` chunks, double-buffered.

@@ -29,6 +29,76 @@ def _uvarint(buf, pos: int):
         shift += 7
 
 
+def scan_tokens(src) -> tuple:
+    """Walk the token *headers* only: ``(n_tokens, literal_only)``.
+
+    The cheap structural probe behind two fast paths: the device decoder
+    (ops/parquet_decode.py) skips its pointer-doubling chase when every
+    page of a chunk is literal-only, and :func:`decompress_fast` collapses
+    a literal-only block to slice copies.  High-entropy data and
+    already-dict-encoded columns compress to a handful of large literals,
+    so this is a few-iteration loop, not a byte-level walk.
+
+    Never raises on corrupt input — callers probing eligibility want a
+    verdict, not an exception; the real decoder reports corruption.
+    """
+    _, pos = _uvarint(src, 0)
+    slen = len(src)
+    n_tokens = 0
+    literal_only = True
+    while pos < slen:
+        tag = src[pos]
+        pos += 1
+        n_tokens += 1
+        kind = tag & 3
+        if kind == 0:
+            length = (tag >> 2) + 1
+            if length > 60:
+                nbytes = length - 60
+                length = int.from_bytes(src[pos:pos + nbytes],
+                                        "little") + 1
+                pos += nbytes
+            pos += length
+        else:
+            literal_only = False
+            pos += (2, 3, 5)[kind - 1] - 1
+    return n_tokens, literal_only
+
+
+def decompress_fast(src: bytes) -> bytes:
+    """`decompress` with a zero-parse fast path for literal-only blocks.
+
+    A block whose token scan finds no back-references is just its literals
+    concatenated — each token becomes one slice copy (typically ONE for
+    page-sized data, since a literal can span 4 GiB).  Anything else falls
+    back to the byte-exact sequential decoder.
+    """
+    n_tokens, literal_only = scan_tokens(src)
+    if not literal_only:
+        return decompress(src)
+    n, pos = _uvarint(src, 0)
+    slen = len(src)
+    parts = []
+    total = 0
+    for _ in range(n_tokens):
+        tag = src[pos]
+        pos += 1
+        length = (tag >> 2) + 1
+        if length > 60:
+            nbytes = length - 60
+            length = int.from_bytes(src[pos:pos + nbytes], "little") + 1
+            pos += nbytes
+        if pos + length > slen:
+            raise ValueError("corrupt snappy stream: truncated literal")
+        parts.append(src[pos:pos + length])
+        pos += length
+        total += length
+    if total != n:
+        raise ValueError(
+            f"corrupt snappy stream: wrote {total}, header said {n}")
+    return bytes(parts[0]) if len(parts) == 1 else b"".join(parts)
+
+
 def decompress(src: bytes) -> bytes:
     """Decode one snappy raw block (the whole-page unit Parquet uses)."""
     n, pos = _uvarint(src, 0)

@@ -50,6 +50,7 @@ environment flags read once at import:
 | ``SRJT_ADMISSION_BURN`` | ``0.9`` | SLO burn rate (breaches/runs from the profile store) at or above which a saturated server sheds the fingerprint immediately instead of queueing |
 | ``SRJT_SESSION_BUDGET_BYTES`` | ``0`` | per-session device-memory budget charged at chunk boundaries (0 = unlimited; bounds the spill ladder and gates the OOM retry-first path) |
 | ``SRJT_RESULT_CACHE`` | ``0``   | result-set cache capacity (entries) keyed (plan fingerprint, data version); 0 = off |
+| ``SRJT_DEVICE_DECODE`` | ``0``  | device-side parquet page decode (ops/parquet_decode.py): ship compressed pages, decompress + decode in the fused scan segment; ineligible chunks re-plan to the host decoder per chunk |
 | ``JAX_PLATFORMS``     | *(unset)* | jax platform list honored by the bridge server before its first jax touch |
 
 ``refresh()`` re-reads the environment (tests use it); everything else
@@ -139,6 +140,7 @@ class Config:
     admission_burn: float = 0.9  # burn rate that sheds when saturated
     session_budget_bytes: int = 0  # per-session memory budget (0=unlimited)
     result_cache: int = 0        # result-set cache capacity (0 = off)
+    device_decode: bool = False  # device-side parquet page decode
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -189,6 +191,7 @@ class Config:
             admission_burn=_float_flag("SRJT_ADMISSION_BURN", 0.9),
             session_budget_bytes=_int_flag("SRJT_SESSION_BUDGET_BYTES", 0),
             result_cache=_int_flag("SRJT_RESULT_CACHE", 0),
+            device_decode=_bool_flag("SRJT_DEVICE_DECODE", False),
         )
 
 

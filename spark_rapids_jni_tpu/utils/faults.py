@@ -45,6 +45,7 @@ from .config import config, logger
 SITES = (
     "parquet.chunk",      # io/parquet.py: per-row-group host decode
     "parquet.prefetch",   # io/parquet.py: prefetch producer thread
+    "parquet.device_decode",  # io/parquet.py: device page-plane transfer
     "staging.transfer",   # io/staging.py: host->device staging
     "exchange.dispatch",  # parallel/shuffle.py: per-chunk shuffle dispatch
     "spill.write",        # parallel/spill.py: spill-pass buffer write

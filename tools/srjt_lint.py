@@ -78,7 +78,7 @@ PKG = "spark_rapids_jni_tpu"
 #: file (repo-relative) -> function names whose bodies are jax-traced
 TRACED_FUNCS = {
     f"{PKG}/engine/segment.py": {"_build_fn", "_probe_join_node",
-                                 "_build_fused_fn"},
+                                 "_build_fused_fn", "_build_decode_fn"},
     f"{PKG}/engine/executor.py": {"_eval_expr"},
 }
 
@@ -646,6 +646,36 @@ def segments_pass(full: bool = False) -> list:
                   f"{fused_syncs} sync(s) on {ndev} device(s)")
         finally:
             _cfg.fuse_exchange = saved
+
+        # the device-decode artifact: plan real page geometry off the
+        # warehouse fact file and lint the fused scan+decode program
+        # (verify.lint_decode_segment) — the decode prefix must splice
+        # into the scan segment with ZERO added host syncs or callbacks
+        from spark_rapids_jni_tpu.engine import segment as _sg
+        from spark_rapids_jni_tpu.engine.plan import Scan as _Scan
+        from spark_rapids_jni_tpu.engine.plan import topo_nodes as _topo
+        from spark_rapids_jni_tpu.engine.verify import lint_decode_segment
+        from spark_rapids_jni_tpu.io.parquet import (ParquetFile,
+                                                     plan_device_group)
+        copt = plans["chunked"]
+        sn = next(n for n in _topo(copt) if isinstance(n, _Scan))
+        seg = _sg.build_stream_segment(copt, sn, _sg.parent_counts(copt))
+        chunk, reason = plan_device_group(
+            ParquetFile(os.path.join(tmp, "store_sales.parquet")), 0,
+            None, 1 << 30)
+        if seg is None or chunk is None:
+            out.append(_violation(
+                "missing-decode-artifact", "<plan:chunked>", 0,
+                f"no fused scan+decode jaxpr to lint "
+                f"(segment={seg is not None}, plan reason={reason})"))
+        else:
+            rep = lint_decode_segment(seg, chunk.geom)
+            for v in rep["violations"]:
+                out.append(_violation(v["code"], "<decode:chunked>", 0,
+                                      v.get("detail", "")))
+            print(f"srjt-lint: device-decode: fused scan+decode jaxpr, "
+                  f"{rep['primitives']} primitive(s), "
+                  f"{len(rep['violations'])} violation(s)")
     return out
 
 
